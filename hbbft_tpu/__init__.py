@@ -1,0 +1,30 @@
+"""hbbft_tpu — a TPU-native asynchronous BFT consensus framework.
+
+A brand-new implementation (not a port) with the capability surface of the
+``zhaohanjin/hbbft`` reference (Honey Badger BFT, Rust): the full protocol
+stack — reliable broadcast with Reed-Solomon erasure coding and Merkle
+proofs, binary agreement with a threshold-signature common coin,
+asynchronous common subset, HoneyBadger atomic broadcast with per-epoch
+threshold decryption, dynamic membership with distributed key generation —
+rebuilt idiomatically in Python/JAX with a pluggable ``CryptoBackend``
+whose pairing-heavy inner loop (BLS12-381 share verification) is batched
+onto TPU.
+
+Reference layout (upstream ``poanetwork/hbbft`` paths; the fork checkout at
+/root/reference was empty at survey time — see SURVEY.md "evidentiary
+status"): ``src/lib.rs``, ``src/traits.rs`` for the substrate;
+``src/{broadcast,binary_agreement,subset,honey_badger,...}`` for protocols;
+the external ``threshold_crypto`` crate for L0.
+"""
+
+__version__ = "0.1.0"
+
+from hbbft_tpu.protocols.traits import (  # noqa: F401
+    ConsensusProtocol,
+    SourcedMessage,
+    Step,
+    Target,
+    TargetedMessage,
+)
+from hbbft_tpu.protocols.network_info import NetworkInfo  # noqa: F401
+from hbbft_tpu.protocols.fault_log import Fault, FaultLog  # noqa: F401

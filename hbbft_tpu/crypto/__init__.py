@@ -1,0 +1,38 @@
+"""L0 cryptography: threshold BLS signatures/encryption with pluggable backends.
+
+Reference: the external ``threshold_crypto`` crate re-exported as
+``hbbft::crypto`` (upstream ``poanetwork/threshold_crypto``:
+``src/lib.rs``, ``src/poly.rs``).  Fork checkout empty at survey time; see
+SURVEY.md §2 #14.
+
+Structure (TPU-first redesign, not a port):
+
+* :mod:`~hbbft_tpu.crypto.suite` — an abstract *group suite* (G1, G2,
+  pairing, hash-to-curve).  Two host-side suites: the insecure
+  ``ScalarSuite`` (fast, for protocol-logic tests) and ``BLSSuite``
+  (pure-Python BLS12-381 oracle).
+* :mod:`~hbbft_tpu.crypto.keys` — the threshold scheme, generic over a
+  suite: ``SecretKeySet``/``PublicKeySet``/shares, signatures, hybrid
+  threshold encryption, Lagrange combination.
+* :mod:`~hbbft_tpu.crypto.backend` — the pluggable ``CryptoBackend``
+  (BASELINE.json:5): batch verification of signature/decryption shares and
+  ciphertexts, with random-linear-combination collapsing so a whole
+  epoch's checks cost O(#distinct messages) pairings.
+* :mod:`~hbbft_tpu.crypto.tpu` — the JAX/TPU batched pairing backend
+  (in progress; ``BLSSuite`` and ``TpuBackend`` land in later milestones
+  of this build — until then only the suites above exist).
+"""
+
+from hbbft_tpu.crypto.keys import (  # noqa: F401
+    Ciphertext,
+    DecryptionShare,
+    PublicKey,
+    PublicKeySet,
+    PublicKeyShare,
+    SecretKey,
+    SecretKeySet,
+    SecretKeyShare,
+    Signature,
+    SignatureShare,
+)
+from hbbft_tpu.crypto.suite import ScalarSuite  # noqa: F401

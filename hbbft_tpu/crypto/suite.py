@@ -1,0 +1,129 @@
+"""Abstract *group suite*: the algebra the threshold scheme is generic over.
+
+The reference hardwires BLS12-381 via the ``pairing`` crate (upstream
+``threshold_crypto/src/lib.rs``).  Here the scheme is written once against
+this suite interface and instantiated with:
+
+* :class:`ScalarSuite` — **insecure** arithmetic in Z_r where the "groups"
+  are the additive group of integers mod r and the "pairing" is plain
+  multiplication.  Structurally identical to BLS (linear scheme, Lagrange
+  in the exponent, pairing product equations) but with trivial discrete
+  logs — used only to make protocol-logic tests fast and deterministic.
+* ``BLSSuite`` (:mod:`hbbft_tpu.crypto.bls`) — real BLS12-381,
+  pure-Python oracle implementation.
+
+Conventions (matching ``threshold_crypto``): public keys live in G1,
+signatures and hashed messages in G2, decryption shares in G1.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+from dataclasses import dataclass
+from typing import Any, List, Sequence, Tuple
+
+from hbbft_tpu.utils import canonical_bytes
+
+
+class Suite(abc.ABC):
+    """A pairing-friendly group suite."""
+
+    name: str
+    scalar_modulus: int  # order r of G1/G2
+
+    # -- group elements ----------------------------------------------
+    @abc.abstractmethod
+    def g1_generator(self) -> Any: ...
+
+    @abc.abstractmethod
+    def g2_generator(self) -> Any: ...
+
+    @abc.abstractmethod
+    def g1_identity(self) -> Any: ...
+
+    @abc.abstractmethod
+    def g2_identity(self) -> Any: ...
+
+    # -- hashing ------------------------------------------------------
+    @abc.abstractmethod
+    def hash_to_g2(self, data: bytes) -> Any:
+        """Hash arbitrary bytes to a G2 element of unknown discrete log."""
+
+    def hash_to_scalar(self, data: bytes) -> int:
+        """Hash to a scalar in [0, r)."""
+        h = hashlib.sha3_256(b"h2s" + data).digest()
+        return int.from_bytes(h, "big") % self.scalar_modulus
+
+    # -- pairing ------------------------------------------------------
+    @abc.abstractmethod
+    def pairing_product_is_one(self, pairs: Sequence[Tuple[Any, Any]]) -> bool:
+        """Check ``prod_i e(a_i, b_i) == 1`` for ``(a_i, b_i)`` in G1 x G2."""
+
+    def pairing_eq(self, a1: Any, b1: Any, a2: Any, b2: Any) -> bool:
+        """Check ``e(a1, b1) == e(a2, b2)``."""
+        return self.pairing_product_is_one([(a1, b1), (-a2, b2)])
+
+
+@dataclass(frozen=True)
+class ScalarG:
+    """Element of the insecure scalar "group" (additive Z_r)."""
+
+    value: int
+    modulus: int
+
+    def __add__(self, other: "ScalarG") -> "ScalarG":
+        return ScalarG((self.value + other.value) % self.modulus, self.modulus)
+
+    def __neg__(self) -> "ScalarG":
+        return ScalarG(-self.value % self.modulus, self.modulus)
+
+    def __sub__(self, other: "ScalarG") -> "ScalarG":
+        return self + (-other)
+
+    def __mul__(self, scalar: int) -> "ScalarG":
+        return ScalarG(self.value * (scalar % self.modulus) % self.modulus, self.modulus)
+
+    __rmul__ = __mul__
+
+    def is_identity(self) -> bool:
+        return self.value == 0
+
+    def to_bytes(self) -> bytes:
+        return self.value.to_bytes(32, "big")
+
+
+# A 255-bit prime: the BLS12-381 scalar-field order, so scalars are
+# interchangeable between the mock and the real suite.
+BLS12_381_R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+
+class ScalarSuite(Suite):
+    """INSECURE mock suite over Z_r — protocol tests only (see module doc)."""
+
+    name = "scalar-insecure"
+    scalar_modulus = BLS12_381_R
+
+    def g1_generator(self) -> ScalarG:
+        return ScalarG(1, self.scalar_modulus)
+
+    def g2_generator(self) -> ScalarG:
+        return ScalarG(1, self.scalar_modulus)
+
+    def g1_identity(self) -> ScalarG:
+        return ScalarG(0, self.scalar_modulus)
+
+    def g2_identity(self) -> ScalarG:
+        return ScalarG(0, self.scalar_modulus)
+
+    def hash_to_g2(self, data: bytes) -> ScalarG:
+        h = hashlib.sha3_256(canonical_bytes(b"h2g2", data)).digest()
+        # Avoid 0 (identity) so "unknown dlog" shape is preserved.
+        v = int.from_bytes(h, "big") % (self.scalar_modulus - 1) + 1
+        return ScalarG(v, self.scalar_modulus)
+
+    def pairing_product_is_one(self, pairs: Sequence[Tuple[Any, Any]]) -> bool:
+        acc = 0
+        for a, b in pairs:
+            acc = (acc + a.value * b.value) % self.scalar_modulus
+        return acc == 0

@@ -1,0 +1,17 @@
+"""Deterministic in-process network simulation harness.
+
+Reference: upstream ``tests/net/`` (``NetBuilder``, ``VirtualNet``,
+``Adversary``, ``CrankError``) — split into the ``hbbft_testing`` crate in
+later upstream revisions.  SURVEY.md §4/§2 #16.  Shipped as part of the
+framework (not just the test tree) because the simulator doubles as the
+benchmark driver, as upstream's ``examples/simulation.rs`` does.
+"""
+
+from hbbft_tpu.net.adversary import (  # noqa: F401
+    Adversary,
+    NodeOrderAdversary,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+from hbbft_tpu.net.virtual_net import CrankError, NetBuilder, VirtualNet  # noqa: F401

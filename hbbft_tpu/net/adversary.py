@@ -1,0 +1,91 @@
+"""Adversary framework: scheduling control + Byzantine node control.
+
+Reference: upstream ``tests/net/adversary.rs`` (``Adversary`` trait with
+``pre_crank`` and ``tamper``; stock ``NullAdversary``,
+``NodeOrderAdversary``, ``ReorderingAdversary``, ``RandomAdversary``).
+SURVEY.md §4.
+
+The adversary owns the faulty nodes: messages addressed to a faulty node
+are handed to :meth:`Adversary.on_message_to_faulty`, which may inject
+arbitrary messages "from" any faulty node in response; ``pre_crank`` may
+reorder the pending queue (asynchrony is adversarial scheduling).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, List
+
+if TYPE_CHECKING:
+    from hbbft_tpu.net.virtual_net import NetMessage, VirtualNet
+
+
+class Adversary:
+    """Base adversary: does nothing (crash-faulty faulty nodes)."""
+
+    def pre_crank(self, net: "VirtualNet", rng: Any) -> None:
+        """Inspect/reorder ``net.queue`` before the next delivery."""
+
+    def on_message_to_faulty(
+        self, net: "VirtualNet", msg: "NetMessage", rng: Any
+    ) -> List["NetMessage"]:
+        """React to a message delivered to an adversary-controlled node.
+
+        Returns messages to inject into the network (sender must be a
+        faulty node id).
+        """
+        return []
+
+
+class NullAdversary(Adversary):
+    """FIFO delivery, silent faulty nodes."""
+
+
+class NodeOrderAdversary(Adversary):
+    """Delivers pending messages grouped by destination node order."""
+
+    def pre_crank(self, net: "VirtualNet", rng: Any) -> None:
+        if net.queue:
+            net.queue.sort(key=lambda m: net.node_order.index(m.dest))
+
+
+class ReorderingAdversary(Adversary):
+    """Randomly swaps pending messages (bounded reordering)."""
+
+    def __init__(self, swaps_per_crank: int = 8) -> None:
+        self.swaps_per_crank = swaps_per_crank
+
+    def pre_crank(self, net: "VirtualNet", rng: Any) -> None:
+        q = net.queue
+        for _ in range(min(self.swaps_per_crank, len(q))):
+            i = rng.randrange(len(q))
+            j = rng.randrange(len(q))
+            q[i], q[j] = q[j], q[i]
+
+    def on_message_to_faulty(self, net, msg, rng):
+        return []
+
+
+class RandomAdversary(Adversary):
+    """Picks a uniformly random pending message to deliver next, and
+    echoes garbage-free random replays from faulty nodes with probability
+    ``replay_p`` (replay = duplicate of a previously observed message)."""
+
+    def __init__(self, replay_p: float = 0.0) -> None:
+        self.replay_p = replay_p
+        self._observed: List[Any] = []
+
+    def pre_crank(self, net: "VirtualNet", rng: Any) -> None:
+        if len(net.queue) > 1:
+            i = rng.randrange(len(net.queue))
+            net.queue[0], net.queue[i] = net.queue[i], net.queue[0]
+
+    def on_message_to_faulty(self, net, msg, rng):
+        from hbbft_tpu.net.virtual_net import NetMessage
+
+        self._observed.append(msg)
+        out: List[NetMessage] = []
+        if self.replay_p > 0 and rng.random() < self.replay_p and self._observed:
+            replay = self._observed[rng.randrange(len(self._observed))]
+            for dest in net.correct_ids:
+                out.append(NetMessage(sender=msg.dest, dest=dest, payload=replay.payload))
+        return out

@@ -1,0 +1,280 @@
+"""VirtualNet: every node's protocol instance in one process, single-stepped.
+
+Reference: upstream ``tests/net/mod.rs`` (``NetBuilder``, ``VirtualNet``,
+``crank()``, ``CrankError``) — SURVEY.md §3.5/§4.  Because protocols are
+sans-I/O state machines, "a network" is just a message queue.
+
+TPU-first addition: each node owns a :class:`~hbbft_tpu.crypto.pool.
+VerifyPool`; the net flushes pools through the configured
+``CryptoBackend`` according to ``flush_every`` (1 = eager, reference-
+equivalent; larger = accumulate crypto checks into TPU-sized batches).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from hbbft_tpu.crypto.backend import BatchedBackend, CryptoBackend
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+from hbbft_tpu.crypto.pool import VerifyPool
+from hbbft_tpu.crypto.suite import ScalarSuite, Suite
+from hbbft_tpu.net.adversary import Adversary, NullAdversary
+from hbbft_tpu.protocols.fault_log import FaultLog
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+
+
+class CrankError(Exception):
+    """Message/crank limit exceeded before the run condition was met."""
+
+
+@dataclass
+class NetMessage:
+    sender: Any
+    dest: Any
+    payload: Any
+
+
+@dataclass
+class VirtualNode:
+    id: Any
+    netinfo: NetworkInfo
+    protocol: ConsensusProtocol
+    pool: VerifyPool
+    rng: random.Random
+    outputs: List[Any] = field(default_factory=list)
+    faults: FaultLog = field(default_factory=FaultLog)
+    sent_messages: int = 0
+
+    @property
+    def terminated(self) -> bool:
+        return self.protocol.terminated
+
+
+class VirtualNet:
+    def __init__(
+        self,
+        nodes: Dict[Any, VirtualNode],
+        faulty_ids: Sequence[Any],
+        backend: CryptoBackend,
+        adversary: Adversary,
+        rng: random.Random,
+        flush_every: int = 1,
+        max_cranks: int = 100_000,
+    ) -> None:
+        self.nodes = nodes
+        self.faulty_ids = list(faulty_ids)
+        self.backend = backend
+        self.adversary = adversary
+        self.rng = rng
+        self.flush_every = max(1, flush_every)
+        self.max_cranks = max_cranks
+        self.queue: List[NetMessage] = []
+        self.node_order = sorted(nodes) + sorted(faulty_ids)
+        self.cranks = 0
+        self.delivered = 0
+        self._since_flush = 0
+
+    # -- introspection -------------------------------------------------
+    @property
+    def correct_ids(self) -> List[Any]:
+        return sorted(self.nodes)
+
+    def node(self, node_id: Any) -> VirtualNode:
+        return self.nodes[node_id]
+
+    def all_terminated(self) -> bool:
+        return all(n.terminated for n in self.nodes.values())
+
+    def outputs(self) -> Dict[Any, List[Any]]:
+        return {nid: list(n.outputs) for nid, n in self.nodes.items()}
+
+    def correct_faults(self) -> List[Any]:
+        """Faults *recorded by* correct nodes *against* correct nodes."""
+        correct = set(self.nodes)
+        return [
+            f
+            for n in self.nodes.values()
+            for f in n.faults
+            if f.node_id in correct
+        ]
+
+    # -- driving -------------------------------------------------------
+    def send_input(self, node_id: Any, input: Any) -> None:
+        node = self.nodes[node_id]
+        step = node.protocol.handle_input(input, node.rng)
+        self._process_step(node, step)
+        self._maybe_flush()
+
+    def broadcast_input(self, input_fn: Callable[[Any], Any]) -> None:
+        for nid in sorted(self.nodes):
+            self.send_input(nid, input_fn(nid))
+
+    def inject(self, msg: NetMessage) -> None:
+        self.queue.append(msg)
+
+    def crank(self) -> bool:
+        """Deliver one message.  Returns False when idle (nothing pending)."""
+        self.cranks += 1
+        if self.cranks > self.max_cranks:
+            raise CrankError(
+                f"exceeded {self.max_cranks} cranks; delivered={self.delivered}"
+            )
+        self.adversary.pre_crank(self, self.rng)
+        if not self.queue:
+            # Drain any deferred verifications so progress can resume.
+            self._flush_all_pools()
+            return bool(self.queue)
+        msg = self.queue.pop(0)
+        if msg.dest in self.faulty_ids:
+            for injected in self.adversary.on_message_to_faulty(self, msg, self.rng):
+                self.queue.append(injected)
+            return True
+        node = self.nodes.get(msg.dest)
+        if node is None:
+            return True  # unknown destination: drop
+        step = node.protocol.handle_message(msg.sender, msg.payload, node.rng)
+        self.delivered += 1
+        self._process_step(node, step)
+        self._maybe_flush()
+        return True
+
+    def crank_until(
+        self, pred: Callable[["VirtualNet"], bool], max_cranks: Optional[int] = None
+    ) -> None:
+        limit = max_cranks if max_cranks is not None else self.max_cranks
+        for _ in range(limit):
+            if pred(self):
+                return
+            made_progress = self.crank()
+            if not made_progress and not self.queue:
+                self._flush_all_pools()
+                if not self.queue and pred(self):
+                    return
+                if not self.queue:
+                    raise CrankError("network idle but condition not met")
+        if pred(self):
+            return
+        raise CrankError(f"condition not met after {limit} cranks")
+
+    def run_to_termination(self, max_cranks: Optional[int] = None) -> None:
+        self.crank_until(lambda net: net.all_terminated(), max_cranks)
+
+    # -- internals -----------------------------------------------------
+    def _process_step(self, node: VirtualNode, step: Step) -> None:
+        node.outputs.extend(step.output)
+        node.faults.extend(step.fault_log)
+        all_ids = self.node_order
+        for tm in step.messages:
+            node.sent_messages += 1
+            for dest in tm.target.recipients(all_ids, node.id):
+                self.queue.append(NetMessage(node.id, dest, tm.message))
+
+    def _maybe_flush(self) -> None:
+        self._since_flush += 1
+        if self._since_flush >= self.flush_every:
+            self._flush_all_pools()
+
+    def _flush_all_pools(self) -> None:
+        self._since_flush = 0
+        for nid in sorted(self.nodes):
+            node = self.nodes[nid]
+            while node.pool:
+                step = node.pool.flush(self.backend)
+                self._process_step(node, step)
+
+
+class NetBuilder:
+    """Configures and builds a :class:`VirtualNet`.
+
+    Reference: upstream ``NetBuilder`` (node count, faulty set, adversary,
+    RNG seed, limits).  Key generation is dealer-based
+    (``SecretKeySet.random``) exactly as in upstream tests.
+    """
+
+    def __init__(self, num_nodes: int, seed: int = 0) -> None:
+        self.num_nodes = num_nodes
+        self.seed = seed
+        self._num_faulty: Optional[int] = None
+        self._suite: Suite = ScalarSuite()
+        self._backend_factory: Callable[[Suite], CryptoBackend] = BatchedBackend
+        self._adversary: Adversary = NullAdversary()
+        self._protocol_factory: Optional[Callable[..., ConsensusProtocol]] = None
+        self._flush_every = 1
+        self._max_cranks = 100_000
+
+    def num_faulty(self, f: int) -> "NetBuilder":
+        self._num_faulty = f
+        return self
+
+    def suite(self, suite: Suite) -> "NetBuilder":
+        self._suite = suite
+        return self
+
+    def backend(self, factory: Callable[[Suite], CryptoBackend]) -> "NetBuilder":
+        self._backend_factory = factory
+        return self
+
+    def adversary(self, adv: Adversary) -> "NetBuilder":
+        self._adversary = adv
+        return self
+
+    def flush_every(self, k: int) -> "NetBuilder":
+        self._flush_every = k
+        return self
+
+    def max_cranks(self, k: int) -> "NetBuilder":
+        self._max_cranks = k
+        return self
+
+    def protocol(
+        self, factory: Callable[[NetworkInfo, Any, random.Random], ConsensusProtocol]
+    ) -> "NetBuilder":
+        """``factory(netinfo, sink, rng) -> protocol instance``."""
+        self._protocol_factory = factory
+        return self
+
+    def build(self) -> VirtualNet:
+        assert self._protocol_factory is not None, "protocol factory required"
+        rng = random.Random(self.seed)
+        n = self.num_nodes
+        f = self._num_faulty if self._num_faulty is not None else (n - 1) // 3
+        assert 3 * f < n, f"need 3f < N (got N={n}, f={f})"
+        ids = list(range(n))
+        faulty_ids = ids[n - f :] if f else []
+        correct_ids = ids[: n - f]
+
+        suite = self._suite
+        sks = SecretKeySet.random(f, rng, suite)
+        pks = sks.public_keys()
+        node_sks = {i: SecretKey.random(rng, suite) for i in ids}
+        node_pks = {i: node_sks[i].public_key() for i in ids}
+
+        nodes: Dict[Any, VirtualNode] = {}
+        for i in correct_ids:
+            netinfo = NetworkInfo(
+                our_id=i,
+                val_ids=ids,
+                public_key_set=pks,
+                secret_key_share=sks.secret_key_share(i),
+                public_keys=node_pks,
+                secret_key=node_sks[i],
+            )
+            pool = VerifyPool()
+            node_rng = random.Random((self.seed << 16) ^ (i + 1))
+            proto = self._protocol_factory(netinfo, pool, node_rng)
+            nodes[i] = VirtualNode(
+                id=i, netinfo=netinfo, protocol=proto, pool=pool, rng=node_rng
+            )
+
+        return VirtualNet(
+            nodes=nodes,
+            faulty_ids=faulty_ids,
+            backend=self._backend_factory(suite),
+            adversary=self._adversary,
+            rng=rng,
+            flush_every=self._flush_every,
+            max_cranks=self._max_cranks,
+        )

@@ -1,0 +1,8 @@
+"""Protocol plane: pure host-side state machines.
+
+Every protocol is a deterministic state machine consuming ``(sender,
+message)`` pairs and inputs and emitting a :class:`~hbbft_tpu.protocols.
+traits.Step`.  No I/O, no threads, no clock — the caller owns the event
+loop and the transport, exactly as in the reference (upstream
+``src/lib.rs`` module docs).
+"""

@@ -1,0 +1,131 @@
+"""Threshold-scheme unit tests against the (insecure) scalar suite.
+
+These exercise the suite-generic algebra: share interpolation, signature
+combine stability across share subsets, encryption round-trips, bivariate
+polynomial symmetry (the DKG invariant), and batch verification with
+fault isolation.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import BatchedBackend, EagerBackend, VerifyRequest
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+from hbbft_tpu.crypto.poly import BivarPoly, Poly, interpolate, lagrange_coefficients
+from hbbft_tpu.crypto.suite import ScalarSuite
+
+
+@pytest.fixture
+def suite():
+    return ScalarSuite()
+
+
+@pytest.fixture
+def rng():
+    return random.Random(1234)
+
+
+def test_poly_interpolation(rng, suite):
+    m = suite.scalar_modulus
+    p = Poly.random(3, rng, m)
+    pts = [(x, p.eval(x)) for x in (2, 5, 7, 11)]
+    assert interpolate(pts, m) == p.eval(0)
+    lam = lagrange_coefficients([1, 4, 6, 10], m)
+    acc = sum(lam[i] * p.eval(i + 1) for i in lam) % m
+    assert acc == p.eval(0)
+
+
+def test_sign_combine_stable_across_subsets(rng, suite):
+    sks = SecretKeySet.random(2, rng, suite)
+    pks = sks.public_keys()
+    msg = b"hello threshold world"
+    shares = {i: sks.secret_key_share(i).sign(msg) for i in range(7)}
+    sig_a = pks.combine_signatures({i: shares[i] for i in (0, 1, 2)})
+    sig_b = pks.combine_signatures({i: shares[i] for i in (3, 5, 6)})
+    sig_c = pks.combine_signatures(shares)
+    assert sig_a.g2 == sig_b.g2 == sig_c.g2
+    assert pks.verify_signature(msg, sig_a)
+    assert not pks.verify_signature(b"other message", sig_a)
+
+
+def test_share_verification(rng, suite):
+    sks = SecretKeySet.random(1, rng, suite)
+    pks = sks.public_keys()
+    msg = b"doc"
+    good = sks.secret_key_share(2).sign(msg)
+    assert pks.public_key_share(2).verify_share(msg, good)
+    assert not pks.public_key_share(3).verify_share(msg, good)  # wrong index
+    assert not pks.public_key_share(2).verify_share(b"doc2", good)  # wrong msg
+
+
+def test_encrypt_decrypt_roundtrip(rng, suite):
+    sks = SecretKeySet.random(2, rng, suite)
+    pks = sks.public_keys()
+    msg = b"the quick brown fox jumps over the lazy dog"
+    ct = pks.public_key().encrypt(msg, rng)
+    assert ct.verify()
+    shares = {i: sks.secret_key_share(i).decryption_share(ct) for i in (1, 3, 4)}
+    for i, sh in shares.items():
+        assert pks.public_key_share(i).verify_decryption_share(ct, sh)
+    assert pks.combine_decryption_shares(shares, ct) == msg
+    # A share from the wrong key fails verification.
+    bad = sks.secret_key_share(0).decryption_share(ct)
+    assert not pks.public_key_share(5).verify_decryption_share(ct, bad)
+
+
+def test_regular_keys(rng, suite):
+    sk = SecretKey.random(rng, suite)
+    pk = sk.public_key()
+    sig = sk.sign(b"vote payload")
+    assert pk.verify(b"vote payload", sig)
+    assert not pk.verify(b"other", sig)
+    ct = pk.encrypt(b"dkg row bytes", rng)
+    assert sk.decrypt(ct) == b"dkg row bytes"
+
+
+def test_bivar_poly_symmetry_and_rows(rng, suite):
+    m = suite.scalar_modulus
+    bp = BivarPoly.random(2, rng, m)
+    assert bp.eval(3, 8) == bp.eval(8, 3)
+    row5 = bp.row(5)
+    assert row5.eval(9) == bp.eval(5, 9)
+    # Commitment consistency: committed row(x).eval(y) == committed eval(x, y)
+    bc = bp.commitment(suite)
+    assert bc.row(5).eval(9) == bc.eval(5, 9)
+    assert bc.row(5).eval(9) == suite.g1_generator() * bp.eval(5, 9)
+    # Interpolating row values at y=0 across t+1 x-points recovers p(0, y0):
+    # node j learns p(i+1, j+1) from t+1 dealers' rows -> interpolate x->p(x, j+1) at 0.
+    j = 4
+    pts = [(i + 1, bp.eval(i + 1, j + 1)) for i in range(3)]
+    assert interpolate(pts, m) == bp.eval(0, j + 1)
+
+
+def test_batched_backend_matches_eager_and_isolates_faults(rng, suite):
+    sks = SecretKeySet.random(2, rng, suite)
+    pks = sks.public_keys()
+    msg = b"common coin round 7"
+    reqs = []
+    for i in range(8):
+        share = sks.secret_key_share(i).sign(msg)
+        reqs.append(VerifyRequest.sig_share(pks.public_key_share(i), msg, share))
+    # Corrupt two entries: wrong message and wrong signer index.
+    bad1 = sks.secret_key_share(3).sign(b"tampered")
+    reqs[3] = VerifyRequest.sig_share(pks.public_key_share(3), msg, bad1)
+    reqs[6] = VerifyRequest.sig_share(
+        pks.public_key_share(6), msg, sks.secret_key_share(5).sign(msg)
+    )
+    # Mix in ciphertext + decryption-share requests.
+    ct = pks.public_key().encrypt(b"payload", rng)
+    reqs.append(VerifyRequest.ciphertext(ct))
+    ds = sks.secret_key_share(1).decryption_share(ct)
+    reqs.append(VerifyRequest.dec_share(pks.public_key_share(1), ct, ds))
+    reqs.append(VerifyRequest.dec_share(pks.public_key_share(2), ct, ds))  # bad
+
+    eager = EagerBackend(suite).verify_batch(reqs)
+    batched = BatchedBackend(suite).verify_batch(reqs)
+    assert eager == batched
+    expected = [True] * 8 + [True, True, False]
+    expected[3] = False
+    expected[6] = False
+    assert batched == expected

@@ -1,0 +1,43 @@
+"""Substrate unit tests: Target routing, Step algebra, NetworkInfo sizes."""
+
+from hbbft_tpu.protocols.fault_log import FaultLog
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.protocols.traits import Step, Target, TargetedMessage
+
+
+def test_target_expansion():
+    ids = [0, 1, 2, 3]
+    assert Target.all().recipients(ids, 0) == [1, 2, 3]
+    assert Target.all_except([2]).recipients(ids, 0) == [1, 3]
+    assert sorted(Target.nodes([1, 3]).recipients(ids, 3)) == [1]
+    assert Target.node(2).recipients(ids, 0) == [2]
+
+
+def test_step_merge_and_map():
+    a = Step().with_output("x").broadcast("m1")
+    b = Step().send(3, "m2")
+    b.fault(7, "some-kind")
+    a.extend(b)
+    assert a.output == ["x"]
+    assert [m.message for m in a.messages] == ["m1", "m2"]
+    assert len(a.fault_log) == 1
+
+    wrapped = a.map_messages(lambda m: ("wrap", m))
+    assert [m.message for m in wrapped.messages] == [("wrap", "m1"), ("wrap", "m2")]
+    assert wrapped.output == ["x"]
+    assert len(wrapped.fault_log) == 1
+    # Targets preserved under wrapping.
+    assert wrapped.messages[0].target == Target.all()
+    assert wrapped.messages[1].target == Target.node(3)
+
+
+def test_network_info_sizes():
+    ni = NetworkInfo(our_id=2, val_ids=range(10), public_key_set=None)
+    assert ni.num_nodes == 10
+    assert ni.num_faulty == 3
+    assert ni.num_correct == 7
+    assert ni.index(5) == 5
+    assert ni.is_validator()
+    observer = NetworkInfo(our_id="obs", val_ids=range(4), public_key_set=None)
+    assert not observer.is_validator()
+    assert observer.num_faulty == 1

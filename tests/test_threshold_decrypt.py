@@ -1,0 +1,47 @@
+"""ThresholdDecrypt over the VirtualNet.
+
+Reference analog: decryption paths of upstream ``tests/honey_badger.rs``
+plus ``src/threshold_decrypt.rs`` unit behavior.
+"""
+
+import random
+
+from hbbft_tpu.crypto.keys import Ciphertext, SecretKeySet
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.net import NetBuilder, ReorderingAdversary
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt
+
+PLAINTEXT = b"batch contribution: txns 17, 42"
+
+
+def test_all_nodes_decrypt():
+    net = (
+        NetBuilder(7, seed=3)
+        .protocol(lambda ni, sink, rng: ThresholdDecrypt(ni, sink))
+        .adversary(ReorderingAdversary())
+        .build()
+    )
+    pk = net.node(0).netinfo.public_key_set.public_key()
+    ct = pk.encrypt(PLAINTEXT, random.Random(99))
+    net.broadcast_input(lambda nid: ct)
+    net.run_to_termination()
+    for nid in net.correct_ids:
+        assert net.node(nid).outputs == [PLAINTEXT]
+    assert net.correct_faults() == []
+
+
+def test_invalid_ciphertext_flagged():
+    net = (
+        NetBuilder(4, seed=5)
+        .protocol(lambda ni, sink, rng: ThresholdDecrypt(ni, sink))
+        .build()
+    )
+    suite = ScalarSuite()
+    pk = net.node(0).netinfo.public_key_set.public_key()
+    good = pk.encrypt(PLAINTEXT, random.Random(1))
+    # Tamper with W so the validity pairing check fails.
+    bad = Ciphertext(good.u, good.v, good.w + suite.g2_generator(), suite)
+    net.send_input(0, bad)
+    net.crank_until(lambda n: n.node(0).protocol.terminated, max_cranks=1000)
+    assert net.node(0).protocol.ciphertext_invalid
+    assert net.node(0).outputs == []

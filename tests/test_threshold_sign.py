@@ -1,0 +1,85 @@
+"""ThresholdSign over the VirtualNet — benchmark config 1 shape (4-of-7).
+
+Reference test analog: upstream ``tests/threshold_sign.rs`` — all correct
+nodes terminate with the identical valid signature and empty fault logs.
+"""
+
+import pytest
+
+from hbbft_tpu.crypto.keys import SignatureShare
+from hbbft_tpu.net import NetBuilder, NullAdversary, RandomAdversary, ReorderingAdversary
+from hbbft_tpu.net.virtual_net import NetMessage
+from hbbft_tpu.protocols.threshold_sign import SignMessage, ThresholdSign
+
+DOC = b"sign me: epoch 0 coin"
+
+
+def build_net(n=7, seed=0, adversary=None, flush_every=1):
+    b = (
+        NetBuilder(n, seed=seed)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, DOC, sink))
+        .flush_every(flush_every)
+    )
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+@pytest.mark.parametrize("adversary", [NullAdversary(), ReorderingAdversary()])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_all_nodes_agree_on_signature(adversary, seed):
+    net = build_net(seed=seed, adversary=adversary)
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    outs = net.outputs()
+    sigs = {nid: o for nid, (o,) in ((k, v) for k, v in outs.items())}
+    first = next(iter(sigs.values()))
+    assert all(s.g2 == first.g2 for s in sigs.values())
+    pks = net.node(0).netinfo.public_key_set
+    assert pks.verify_signature(DOC, first)
+    assert net.correct_faults() == []
+
+
+def test_batched_flush_policy_same_result():
+    net_eager = build_net(seed=42, flush_every=1)
+    net_batch = build_net(seed=42, flush_every=8)
+    for net in (net_eager, net_batch):
+        net.broadcast_input(lambda nid: None)
+        net.run_to_termination()
+    sig_a = net_eager.node(0).outputs[0]
+    sig_b = net_batch.node(0).outputs[0]
+    assert sig_a.g2 == sig_b.g2
+
+
+def test_invalid_share_is_faulted():
+    net = build_net(n=7)
+    # Inject a garbage share "from" faulty node 6 to node 0 ahead of all
+    # honest traffic, so it is verified before node 0 can terminate.
+    suite = net.node(0).netinfo.public_key_set.suite
+    bogus = SignatureShare(suite.hash_to_g2(b"garbage"), suite)
+    net.inject(NetMessage(sender=6, dest=0, payload=SignMessage(bogus)))
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    faults = [f for f in net.node(0).faults if f.node_id == 6]
+    assert any("invalid-share" in f.kind for f in faults)
+    # Consensus still completed despite the bad share.
+    assert net.node(0).outputs
+
+
+def test_observer_numbers():
+    # 10 nodes, f = 3: termination requires only f+1 = 4 shares; drop all
+    # messages from 3 (crash-)faulty nodes and ensure liveness.
+    net = build_net(n=10)
+    assert len(net.faulty_ids) == 3
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    for nid in net.correct_ids:
+        assert len(net.node(nid).outputs) == 1
+
+
+def test_random_adversary_replay_does_not_break(monkeypatch):
+    net = build_net(n=7, seed=9, adversary=RandomAdversary(replay_p=0.5))
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    for nid in net.correct_ids:
+        assert len(net.node(nid).outputs) == 1
