@@ -1,0 +1,132 @@
+"""BLSSuite: plugs BLS12-381 into the suite-generic threshold scheme."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+from hbbft_tpu.crypto.bls import curve as C
+from hbbft_tpu.crypto.bls import fields as F
+from hbbft_tpu.crypto.bls import pairing as PR
+from hbbft_tpu.crypto.suite import Suite
+from hbbft_tpu.utils import canonical_bytes
+
+
+class _PointElem:
+    """Group-element wrapper satisfying the suite element protocol.
+
+    Wraps a Jacobian point; affine form (for serialization/equality) is
+    computed lazily and cached.
+    """
+
+    __slots__ = ("jac", "_affine", "_bytes")
+
+    ops: C.FieldOps  # set on subclasses
+    tag: bytes
+
+    def __init__(self, jac: C.Jac) -> None:
+        self.jac = jac
+        self._affine: Any = _UNSET
+        self._bytes: Optional[bytes] = None
+
+    # -- group ops -----------------------------------------------------
+    def __add__(self, other: "_PointElem"):
+        return type(self)(C.jac_add(self.ops, self.jac, other.jac))
+
+    def __neg__(self):
+        return type(self)(C.jac_neg(self.ops, self.jac))
+
+    def __sub__(self, other: "_PointElem"):
+        return self + (-other)
+
+    def __mul__(self, scalar: int):
+        return type(self)(C.jac_mul(self.ops, self.jac, scalar % F.R))
+
+    __rmul__ = __mul__
+
+    def is_identity(self) -> bool:
+        return C.jac_is_identity(self.ops, self.jac)
+
+    # -- representation ------------------------------------------------
+    def affine(self):
+        if self._affine is _UNSET:
+            self._affine = C.jac_to_affine(self.ops, self.jac)
+        return self._affine
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, _PointElem) or self.tag != other.tag:
+            return NotImplemented
+        return C.jac_eq(self.ops, self.jac, other.jac)
+
+    def __hash__(self) -> int:
+        return hash(self.to_bytes())
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.to_bytes().hex()[:16]}…)"
+
+
+_UNSET = object()
+
+
+class G1Elem(_PointElem):
+    ops = C.FQ_OPS
+    tag = b"g1"
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            aff = self.affine()
+            if aff is None:
+                self._bytes = b"\x00" * 97
+            else:
+                self._bytes = (
+                    b"\x01" + aff[0].to_bytes(48, "big") + aff[1].to_bytes(48, "big")
+                )
+        return self._bytes
+
+
+class G2Elem(_PointElem):
+    ops = C.FQ2_OPS
+    tag = b"g2"
+
+    def to_bytes(self) -> bytes:
+        if self._bytes is None:
+            aff = self.affine()
+            if aff is None:
+                self._bytes = b"\x00" * 193
+            else:
+                (x0, x1), (y0, y1) = aff
+                self._bytes = (
+                    b"\x01"
+                    + x0.to_bytes(48, "big")
+                    + x1.to_bytes(48, "big")
+                    + y0.to_bytes(48, "big")
+                    + y1.to_bytes(48, "big")
+                )
+        return self._bytes
+
+
+class BLSSuite(Suite):
+    """Real BLS12-381 suite (pure-Python oracle backend)."""
+
+    name = "bls12-381"
+    scalar_modulus = F.R
+
+    def g1_generator(self) -> G1Elem:
+        return G1Elem(C.G1_GEN)
+
+    def g2_generator(self) -> G2Elem:
+        return G2Elem(C.G2_GEN)
+
+    def g1_identity(self) -> G1Elem:
+        return G1Elem(C.jac_identity(C.FQ_OPS))
+
+    def g2_identity(self) -> G2Elem:
+        return G2Elem(C.jac_identity(C.FQ2_OPS))
+
+    def hash_to_g2(self, data: bytes) -> G2Elem:
+        return G2Elem(C.hash_to_g2(bytes(data)))
+
+    def pairing_product_is_one(
+        self, pairs: Sequence[Tuple[G1Elem, G2Elem]]
+    ) -> bool:
+        aff_pairs = [(a.affine(), b.affine()) for a, b in pairs]
+        return PR.multi_pairing_is_one(aff_pairs)

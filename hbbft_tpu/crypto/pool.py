@@ -75,12 +75,3 @@ class VerifyPool(VerifySink):
         for (req, cb), ok in zip(items, results):
             step.extend(cb(ok))
         return step
-
-    def flush_all(self, backend: CryptoBackend, limit: int = 100) -> Step:
-        """Flush repeatedly until no pending work remains."""
-        step = Step.empty()
-        for _ in range(limit):
-            if not self._items:
-                break
-            step.extend(self.flush(backend))
-        return step

@@ -30,6 +30,50 @@ class CrankError(Exception):
     """Message/crank limit exceeded before the run condition was met."""
 
 
+class MessageQueue:
+    """FIFO with O(1) amortized popleft plus the list-ish surface
+    adversaries use (indexing, in-place sort) — a plain list's ``pop(0)``
+    would make long benchmark runs quadratic in delivered messages."""
+
+    def __init__(self) -> None:
+        self._items: List[Any] = []
+        self._head = 0
+
+    def append(self, item: Any) -> None:
+        self._items.append(item)
+
+    def popleft(self) -> Any:
+        item = self._items[self._head]
+        self._items[self._head] = None  # drop reference
+        self._head += 1
+        if self._head > 64 and self._head * 2 > len(self._items):
+            self._compact()
+        return item
+
+    def _compact(self) -> None:
+        self._items = self._items[self._head :]
+        self._head = 0
+
+    def sort(self, key=None) -> None:
+        self._compact()
+        self._items.sort(key=key)
+
+    def __len__(self) -> int:
+        return len(self._items) - self._head
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __getitem__(self, i: int) -> Any:
+        return self._items[self._head + i]
+
+    def __setitem__(self, i: int, v: Any) -> None:
+        self._items[self._head + i] = v
+
+    def __iter__(self):
+        return iter(self._items[self._head :])
+
+
 @dataclass
 class NetMessage:
     sender: Any
@@ -71,7 +115,7 @@ class VirtualNet:
         self.rng = rng
         self.flush_every = max(1, flush_every)
         self.max_cranks = max_cranks
-        self.queue: List[NetMessage] = []
+        self.queue: MessageQueue = MessageQueue()
         self.node_order = sorted(nodes) + sorted(faulty_ids)
         self.cranks = 0
         self.delivered = 0
@@ -127,7 +171,7 @@ class VirtualNet:
             # Drain any deferred verifications so progress can resume.
             self._flush_all_pools()
             return bool(self.queue)
-        msg = self.queue.pop(0)
+        msg = self.queue.popleft()
         if msg.dest in self.faulty_ids:
             for injected in self.adversary.on_message_to_faulty(self, msg, self.rng):
                 self.queue.append(injected)

@@ -63,18 +63,25 @@ class ThresholdSign(ConsensusProtocol):
         return self._signature
 
     def handle_input(self, input: Any, rng: Any) -> Step:
-        """Start signing (input value is ignored, as in the reference)."""
-        if self._had_input or self._terminated:
+        """Start signing (input value is ignored, as in the reference).
+
+        The share is broadcast even if we already terminated via peers'
+        shares — otherwise slower peers could be starved of their
+        (f+1)-th share forever (liveness).
+        """
+        if self._had_input:
             return Step.empty()
         self._had_input = True
         step = Step.empty()
         if not self._netinfo.is_validator():
             return step
         share = self._netinfo.secret_key_share.sign(self._doc)
-        self._seen.add(self.our_id)
-        self._verified[self.our_id] = share  # own share needs no check
         step.broadcast(SignMessage(share))
-        return step.extend(self._try_output())
+        if not self._terminated:
+            self._seen.add(self.our_id)
+            self._verified[self.our_id] = share  # own share needs no check
+            step.extend(self._try_output())
+        return step
 
     def handle_message(self, sender: Any, message: SignMessage, rng: Any) -> Step:
         step = Step.empty()

@@ -1,0 +1,117 @@
+"""BLS12-381 oracle tests: curve self-validation, pairing laws, and the
+threshold scheme + protocols running over the real curve (small N).
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.backend import BatchedBackend, EagerBackend, VerifyRequest
+from hbbft_tpu.crypto.bls import BLSSuite
+from hbbft_tpu.crypto.bls import curve as C
+from hbbft_tpu.crypto.bls import fields as F
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+
+@pytest.fixture(scope="module")
+def suite():
+    return BLSSuite()
+
+
+@pytest.fixture
+def rng():
+    return random.Random(7)
+
+
+def test_curve_selfcheck():
+    C.selfcheck()
+
+
+def test_field_tower():
+    rng = random.Random(3)
+    a = (rng.randrange(F.P), rng.randrange(F.P))
+    b = (rng.randrange(F.P), rng.randrange(F.P))
+    # Fq2 inverse and sqrt round-trips.
+    assert F.fq2_eq(F.fq2_mul(a, F.fq2_inv(a)), F.FQ2_ONE)
+    sq = F.fq2_sqr(a)
+    r = F.fq2_sqrt(sq)
+    assert r is not None and (F.fq2_eq(r, a) or F.fq2_eq(F.fq2_neg(r), a))
+    # Fq12 inverse and Frobenius composition.
+    x = tuple((rng.randrange(F.P), rng.randrange(F.P)) for _ in range(6))
+    assert F.fq12_is_one(F.fq12_mul(x, F.fq12_inv(x)))
+    f2 = F.fq12_frobenius(F.fq12_frobenius(x, 1), 1)
+    assert F.fq12_eq(f2, F.fq12_frobenius(x, 2))
+    # Frobenius is the p-power map: check multiplicativity frob(xy)=frob(x)frob(y)
+    y = tuple((rng.randrange(F.P), rng.randrange(F.P)) for _ in range(6))
+    assert F.fq12_eq(
+        F.fq12_frobenius(F.fq12_mul(x, y), 1),
+        F.fq12_mul(F.fq12_frobenius(x, 1), F.fq12_frobenius(y, 1)),
+    )
+
+
+def test_pairing_bilinearity(suite):
+    g1, g2 = suite.g1_generator(), suite.g2_generator()
+    a, b = 0xDEADBEEF, 0xCAFE
+    assert suite.pairing_product_is_one([(g1 * a, g2 * b), (-(g1 * (a * b)), g2)])
+    assert suite.pairing_product_is_one([(g1 * a, g2 * b), (g1 * a, -(g2) * b)])
+    assert not suite.pairing_product_is_one([(g1, g2)])  # non-degenerate
+    # identity legs are neutral
+    assert suite.pairing_product_is_one([(suite.g1_identity(), g2)])
+
+
+def test_threshold_scheme_over_bls(suite, rng):
+    sks = SecretKeySet.random(1, rng, suite)
+    pks = sks.public_keys()
+    msg = b"real curve signing"
+    shares = {i: sks.secret_key_share(i).sign(msg) for i in range(4)}
+    assert pks.public_key_share(2).verify_share(msg, shares[2])
+    assert not pks.public_key_share(2).verify_share(b"other", shares[2])
+    sig_a = pks.combine_signatures({i: shares[i] for i in (0, 3)})
+    sig_b = pks.combine_signatures({i: shares[i] for i in (1, 2)})
+    assert sig_a.g2 == sig_b.g2
+    assert pks.verify_signature(msg, sig_a)
+
+    ct = pks.public_key().encrypt(b"secret payload", rng)
+    assert ct.verify()
+    ds = {i: sks.secret_key_share(i).decryption_share(ct) for i in (0, 2)}
+    assert pks.public_key_share(0).verify_decryption_share(ct, ds[0])
+    assert pks.combine_decryption_shares(ds, ct) == b"secret payload"
+
+
+def test_batched_backend_over_bls(suite, rng):
+    sks = SecretKeySet.random(1, rng, suite)
+    pks = sks.public_keys()
+    msg = b"coin round 1"
+    reqs = [
+        VerifyRequest.sig_share(
+            pks.public_key_share(i), msg, sks.secret_key_share(i).sign(msg)
+        )
+        for i in range(4)
+    ]
+    # One corrupted share (signed by the wrong share key).
+    reqs[2] = VerifyRequest.sig_share(
+        pks.public_key_share(2), msg, sks.secret_key_share(3).sign(msg)
+    )
+    batched = BatchedBackend(suite).verify_batch(reqs)
+    assert batched == EagerBackend(suite).verify_batch(reqs)
+    assert batched == [True, True, False, True]
+
+
+@pytest.mark.slow
+def test_threshold_sign_protocol_over_bls():
+    doc = b"bls consensus doc"
+    net = (
+        NetBuilder(4, seed=5)
+        .suite(BLSSuite())
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, doc, sink))
+        .flush_every(4)
+        .build()
+    )
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    sigs = [net.node(nid).outputs[0] for nid in net.correct_ids]
+    assert len({s.g2 for s in sigs}) == 1
+    assert net.node(0).netinfo.public_key_set.verify_signature(doc, sigs[0])
+    assert net.correct_faults() == []
