@@ -64,11 +64,19 @@ class MessageQueue:
     def __bool__(self) -> bool:
         return len(self) > 0
 
+    def _index(self, i: int) -> int:
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError("MessageQueue index out of range")
+        return self._head + i
+
     def __getitem__(self, i: int) -> Any:
-        return self._items[self._head + i]
+        return self._items[self._index(i)]
 
     def __setitem__(self, i: int, v: Any) -> None:
-        self._items[self._head + i] = v
+        self._items[self._index(i)] = v
 
     def __iter__(self):
         return iter(self._items[self._head :])

@@ -1,0 +1,7 @@
+"""Data-plane ops: GF(256) erasure coding, Keccak/SHA3 Merkle hashing.
+
+Host (numpy) implementations here; batched JAX/Pallas equivalents for the
+TPU hot path live in :mod:`hbbft_tpu.ops.jax` (SURVEY.md §2 native-
+components table: ``reed-solomon-erasure`` -> GF(256) table matmuls,
+``tiny-keccak`` -> vmapped Keccak-f[1600]).
+"""

@@ -1,0 +1,230 @@
+"""Broadcast: Bracha reliable broadcast with AVID erasure-coded dispersal.
+
+Reference: upstream ``src/broadcast/broadcast.rs`` (SURVEY.md §2 #4,
+BASELINE.json:8).  Protocol for proposer p, value v:
+
+* p RS-encodes v into N shards (K = N - 2f data + 2f parity), Merkle-
+  hashes them, and sends node i its proof ``Value(proof_i)``.
+* On a valid ``Value``, a node gossips ``Echo(proof_i)`` to everyone.
+* On N - f valid Echos for one root: send ``Ready(root)``.
+* On f + 1 Readys without having sent one: send ``Ready`` (amplification).
+* On 2f + 1 Readys and >= K stored shards: reconstruct, re-encode, and
+  re-hash to verify the root (catches a proposer that encoded garbage),
+  then output the value.
+
+Per-node byte cost is O(|v| * N / K) instead of O(|v| * N).  (The
+``EchoHash``/``CanDecode`` optimizations of later upstream revisions are
+not implemented — fork parity unknown, see SURVEY.md evidentiary note.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from hbbft_tpu.ops.gf256 import ReedSolomon
+from hbbft_tpu.ops.merkle import MerkleTree, Proof
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+
+FAULT_INVALID_PROOF = "broadcast:invalid-proof"
+FAULT_WRONG_INDEX = "broadcast:wrong-shard-index"
+FAULT_NOT_PROPOSER = "broadcast:value-from-non-proposer"
+FAULT_MULTIPLE_VALUES = "broadcast:multiple-values"
+FAULT_DUPLICATE = "broadcast:duplicate-message"
+FAULT_BAD_ENCODING = "broadcast:root-mismatch-after-decode"
+
+
+@dataclass(frozen=True)
+class ValueMsg:
+    proof: Proof
+
+
+@dataclass(frozen=True)
+class EchoMsg:
+    proof: Proof
+
+
+@dataclass(frozen=True)
+class ReadyMsg:
+    root: bytes
+
+
+def _pack(value: bytes, k: int) -> Tuple[bytes, ...]:
+    """Length-prefix and pad ``value`` into k equal shards."""
+    payload = len(value).to_bytes(8, "big") + value
+    shard_len = max(1, -(-len(payload) // k))
+    payload = payload.ljust(k * shard_len, b"\x00")
+    return tuple(payload[i * shard_len : (i + 1) * shard_len] for i in range(k))
+
+
+def _unpack(data_shards: Tuple[bytes, ...]) -> Optional[bytes]:
+    payload = b"".join(data_shards)
+    if len(payload) < 8:
+        return None
+    n = int.from_bytes(payload[:8], "big")
+    if 8 + n > len(payload):
+        return None
+    return payload[8 : 8 + n]
+
+
+class Broadcast(ConsensusProtocol):
+    """One reliable-broadcast instance for a designated proposer."""
+
+    def __init__(self, netinfo: NetworkInfo, proposer_id: Any) -> None:
+        self._netinfo = netinfo
+        self._proposer = proposer_id
+        n, f = netinfo.num_nodes, netinfo.num_faulty
+        self._data_shards = n - 2 * f
+        self._rs = ReedSolomon(self._data_shards, n)
+        self._echos: Dict[Any, Proof] = {}
+        self._readys: Dict[Any, bytes] = {}
+        self._echo_sent = False
+        self._ready_sent = False
+        self._had_input = False
+        self._terminated = False
+        self._value: Optional[bytes] = None
+
+    @property
+    def our_id(self) -> Any:
+        return self._netinfo.our_id
+
+    @property
+    def terminated(self) -> bool:
+        return self._terminated
+
+    @property
+    def value(self) -> Optional[bytes]:
+        return self._value
+
+    # -- input (proposer only) ----------------------------------------
+    def handle_input(self, input: bytes, rng: Any) -> Step:
+        step = Step.empty()
+        if self.our_id != self._proposer or self._had_input:
+            return step
+        self._had_input = True
+        shards = self._rs.encode(list(_pack(bytes(input), self._data_shards)))
+        tree = MerkleTree(shards)
+        our_index = self._netinfo.our_index
+        for nid in self._netinfo.all_ids:
+            proof = tree.proof(self._netinfo.index(nid))
+            if nid == self.our_id:
+                step.extend(self._handle_value(self.our_id, proof))
+            else:
+                step.send(nid, ValueMsg(proof))
+        return step
+
+    # -- messages ------------------------------------------------------
+    def handle_message(self, sender: Any, message: Any, rng: Any) -> Step:
+        step = Step.empty()
+        if self._terminated:
+            return step
+        if not self._netinfo.is_node_validator(sender):
+            return step.fault(sender, FAULT_NOT_PROPOSER)
+        if isinstance(message, ValueMsg):
+            if sender != self._proposer:
+                return step.fault(sender, FAULT_NOT_PROPOSER)
+            return self._handle_value(sender, message.proof)
+        if isinstance(message, EchoMsg):
+            return self._handle_echo(sender, message.proof)
+        if isinstance(message, ReadyMsg):
+            return self._handle_ready(sender, message.root)
+        return step.fault(sender, FAULT_DUPLICATE)
+
+    # -- internals -----------------------------------------------------
+    def _handle_value(self, sender: Any, proof: Proof) -> Step:
+        step = Step.empty()
+        if self._echo_sent:
+            # A second Value with a different root is proposer equivocation.
+            if self._echos.get(self.our_id) and proof.root != self._echos[self.our_id].root:
+                step.fault(sender, FAULT_MULTIPLE_VALUES)
+            return step
+        if proof.index != self._netinfo.our_index or not proof.validate(
+            self._netinfo.num_nodes
+        ):
+            return step.fault(sender, FAULT_INVALID_PROOF)
+        self._echo_sent = True
+        step.broadcast(EchoMsg(proof))
+        step.extend(self._handle_echo(self.our_id, proof))
+        return step
+
+    def _handle_echo(self, sender: Any, proof: Proof) -> Step:
+        step = Step.empty()
+        if sender in self._echos:
+            if self._echos[sender] != proof:
+                step.fault(sender, FAULT_DUPLICATE)
+            return step
+        if proof.index != self._netinfo.index(sender):
+            return step.fault(sender, FAULT_WRONG_INDEX)
+        if not proof.validate(self._netinfo.num_nodes):
+            return step.fault(sender, FAULT_INVALID_PROOF)
+        self._echos[sender] = proof
+        n, f = self._netinfo.num_nodes, self._netinfo.num_faulty
+        root_count = sum(1 for p in self._echos.values() if p.root == proof.root)
+        if root_count >= n - f and not self._ready_sent:
+            step.extend(self._send_ready(proof.root))
+        return step.extend(self._try_decode())
+
+    def _handle_ready(self, sender: Any, root: bytes) -> Step:
+        step = Step.empty()
+        if sender in self._readys:
+            if self._readys[sender] != root:
+                step.fault(sender, FAULT_DUPLICATE)
+            return step
+        self._readys[sender] = root
+        f = self._netinfo.num_faulty
+        count = sum(1 for r in self._readys.values() if r == root)
+        if count >= f + 1 and not self._ready_sent:
+            step.extend(self._send_ready(root))
+        return step.extend(self._try_decode())
+
+    def _send_ready(self, root: bytes) -> Step:
+        step = Step.empty()
+        self._ready_sent = True
+        step.broadcast(ReadyMsg(root))
+        step.extend(self._handle_ready(self.our_id, root))
+        return step
+
+    def _try_decode(self) -> Step:
+        step = Step.empty()
+        if self._terminated:
+            return step
+        f = self._netinfo.num_faulty
+        # A root with 2f+1 Readys is decodable once K shards are stored.
+        from collections import Counter
+
+        ready_roots = Counter(self._readys.values())
+        for root, count in ready_roots.items():
+            if count < 2 * f + 1:
+                continue
+            shards = {
+                p.index: p.value for p in self._echos.values() if p.root == root
+            }
+            if len(shards) < self._data_shards:
+                continue
+            # A Byzantine proposer can commit a tree over unequal-length
+            # (or otherwise undecodable) leaves; that is its fault, not a
+            # crash.
+            lengths = {len(s) for s in shards.values()}
+            if len(lengths) != 1:
+                self._terminated = True
+                return step.fault(self._proposer, FAULT_BAD_ENCODING)
+            try:
+                data = self._rs.reconstruct(shards)
+                full = self._rs.encode(data)
+            except (ValueError, AssertionError):
+                self._terminated = True
+                return step.fault(self._proposer, FAULT_BAD_ENCODING)
+            # Re-encode and re-hash: the root must commit to a consistent
+            # codeword, else the proposer encoded garbage.
+            if MerkleTree(full).root != root:
+                self._terminated = True  # unrecoverable: proposer Byzantine
+                return step.fault(self._proposer, FAULT_BAD_ENCODING)
+            value = _unpack(tuple(data))
+            if value is None:
+                self._terminated = True
+                return step.fault(self._proposer, FAULT_BAD_ENCODING)
+            self._value = value
+            self._terminated = True
+            return step.with_output(value)
+        return step

@@ -1,0 +1,30 @@
+"""Contribution serialization.
+
+The reference serializes contributions with ``bincode`` before threshold-
+encrypting them (upstream ``src/honey_badger/honey_badger.rs``).  Here we
+use pickle: each node only ever deserializes data it (or consensus)
+committed to, in a closed in-process system; no cross-version wire
+stability is required.  Centralized here so a stricter codec can be
+swapped in without touching protocol code.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+
+def dumps(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=4)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def try_loads(data: bytes) -> Any:
+    """Returns None on any malformed input (Byzantine-supplied bytes)."""
+    try:
+        return pickle.loads(data)
+    except Exception:
+        return None

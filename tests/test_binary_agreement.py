@@ -1,0 +1,94 @@
+"""BinaryAgreement tests.
+
+Reference analogs: upstream ``tests/binary_agreement.rs`` (all correct
+nodes decide the same bool; if all inputs agree, that value is decided)
+and ``tests/binary_agreement_mitm.rs`` (a scheduler that delays common-
+coin shares cannot kill liveness).
+"""
+
+import pytest
+
+from hbbft_tpu.net import NetBuilder, NullAdversary, RandomAdversary, ReorderingAdversary
+from hbbft_tpu.net.adversary import Adversary
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement, CoinMsg
+
+
+def build_net(n=4, seed=0, adversary=None):
+    b = NetBuilder(n, seed=seed).protocol(
+        lambda ni, sink, rng: BinaryAgreement(ni, b"aba-session", sink)
+    )
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+def run_and_check(net, expect=None):
+    net.run_to_termination()
+    decisions = {nid: net.node(nid).outputs for nid in net.correct_ids}
+    assert all(len(d) == 1 for d in decisions.values()), decisions
+    values = {d[0] for d in decisions.values()}
+    assert len(values) == 1, f"disagreement: {decisions}"
+    if expect is not None:
+        assert values == {expect}
+    assert net.correct_faults() == []
+    return values.pop()
+
+
+@pytest.mark.parametrize("value", [False, True])
+@pytest.mark.parametrize("n", [1, 4, 7])
+def test_unanimous_input_decides_that_value(n, value):
+    net = build_net(n=n, seed=17)
+    net.broadcast_input(lambda nid: value)
+    run_and_check(net, expect=value)
+
+
+@pytest.mark.parametrize("seed", range(5))
+@pytest.mark.parametrize("adversary_cls", [NullAdversary, ReorderingAdversary, RandomAdversary])
+def test_mixed_inputs_agree(seed, adversary_cls):
+    net = build_net(n=7, seed=seed, adversary=adversary_cls())
+    net.broadcast_input(lambda nid: nid % 2 == 0)
+    run_and_check(net)
+
+
+class CoinDelayAdversary(Adversary):
+    """MITM on the common coin: starves coin-share delivery for a while,
+    forcing rounds to stack up behind the conf stage, then relents.
+    An adversary that cannot break threshold crypto can only *delay* the
+    coin — liveness must survive."""
+
+    def __init__(self, delay_cranks: int = 200) -> None:
+        self.delay_cranks = delay_cranks
+        self.cranks = 0
+
+    def pre_crank(self, net, rng) -> None:
+        self.cranks += 1
+        if self.cranks <= self.delay_cranks and len(net.queue) > 1:
+            non_coin = [m for m in net.queue if not isinstance(getattr(m.payload, "content", None), CoinMsg)]
+            coin = [m for m in net.queue if isinstance(getattr(m.payload, "content", None), CoinMsg)]
+            if coin and non_coin:
+                reordered = non_coin + coin
+                for i in range(len(net.queue)):
+                    net.queue[i] = reordered[i]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_coin_mitm_liveness(seed):
+    net = build_net(n=4, seed=seed, adversary=CoinDelayAdversary())
+    net.broadcast_input(lambda nid: nid % 2 == 0)
+    run_and_check(net)
+
+
+def test_term_shortcut():
+    # A node joining late (no input) can still decide from f+1 Terms.
+    net = build_net(n=4, seed=3)
+    # Give input to all but node 2.
+    for nid in (0, 1):
+        net.send_input(nid, True)
+    net.crank_until(
+        lambda n: sum(1 for i in n.correct_ids if n.node(i).protocol.terminated) >= 2,
+        max_cranks=50_000,
+    )
+    # Now node 2 should be able to finish purely from Term evidence.
+    net.crank_until(lambda n: n.node(2).protocol.terminated, max_cranks=50_000)
+    decisions = {net.node(i).outputs[0] for i in net.correct_ids if net.node(i).outputs}
+    assert decisions == {True}

@@ -1,0 +1,61 @@
+"""Broadcast (RBC) protocol tests — benchmark config 2 shape (10 nodes, 1KB).
+
+Reference analog: upstream ``tests/broadcast.rs``: all correct nodes
+deliver the proposer's value; Byzantine proposers can't cause divergent
+delivery.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.net import NetBuilder, NullAdversary, RandomAdversary, ReorderingAdversary
+from hbbft_tpu.protocols.broadcast import Broadcast
+
+PAYLOAD = bytes(random.Random(0).randrange(256) for _ in range(1024))
+
+
+def build_net(n=10, seed=0, adversary=None, proposer=0):
+    b = NetBuilder(n, seed=seed).protocol(
+        lambda ni, sink, rng: Broadcast(ni, proposer)
+    )
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+@pytest.mark.parametrize(
+    "adversary", [NullAdversary(), ReorderingAdversary(), RandomAdversary()]
+)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_all_deliver_1kb(adversary, seed):
+    net = build_net(seed=seed, adversary=adversary)
+    net.send_input(0, PAYLOAD)
+    net.run_to_termination()
+    for nid in net.correct_ids:
+        assert net.node(nid).outputs == [PAYLOAD]
+    assert net.correct_faults() == []
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 7, 16])
+def test_various_sizes(n):
+    net = build_net(n=n, seed=3)
+    net.send_input(0, b"hello broadcast")
+    net.run_to_termination()
+    for nid in net.correct_ids:
+        assert net.node(nid).outputs == [b"hello broadcast"]
+
+
+def test_empty_and_large_values():
+    for payload in (b"", b"x", bytes(range(256)) * 40):
+        net = build_net(seed=4)
+        net.send_input(0, payload)
+        net.run_to_termination()
+        assert net.node(3).outputs == [payload]
+
+
+def test_non_proposer_input_ignored():
+    net = build_net(seed=5)
+    net.send_input(1, b"not my turn")
+    assert not net.queue
+    assert not net.node(1).protocol.terminated

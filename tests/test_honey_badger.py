@@ -1,0 +1,96 @@
+"""HoneyBadger tests — benchmark config 3 shape (16 nodes, batched txns).
+
+Reference analog: upstream ``tests/honey_badger.rs``: every epoch's batch
+is identical across correct nodes and eventually contains every correct
+node's contribution.
+"""
+
+import pytest
+
+from hbbft_tpu.net import NetBuilder, NullAdversary, RandomAdversary, ReorderingAdversary
+from hbbft_tpu.protocols.honey_badger import Batch, EncryptionSchedule, HoneyBadger
+
+
+def build_hb_net(n=4, seed=0, adversary=None, schedule=None, max_future_epochs=3):
+    schedule = schedule or EncryptionSchedule.always()
+    b = NetBuilder(n, seed=seed).protocol(
+        lambda ni, sink, rng: HoneyBadger(
+            ni, sink, session_id=b"hb-test", max_future_epochs=max_future_epochs,
+            encryption_schedule=schedule,
+        )
+    )
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+def batches_of(net, nid):
+    return [o for o in net.node(nid).outputs if isinstance(o, Batch)]
+
+
+def run_epochs(net, num_epochs, contribution_fn):
+    """Propose per epoch and crank until all correct nodes emit the batch."""
+    for epoch in range(num_epochs):
+        for nid in net.correct_ids:
+            net.send_input(nid, contribution_fn(nid, epoch))
+        net.crank_until(
+            lambda n: all(len(batches_of(n, i)) > epoch for i in n.correct_ids),
+            max_cranks=2_000_000,
+        )
+
+
+@pytest.mark.parametrize("adversary_cls", [NullAdversary, ReorderingAdversary])
+def test_single_epoch_agreement(adversary_cls):
+    net = build_hb_net(n=4, seed=1, adversary=adversary_cls())
+    run_epochs(net, 1, lambda nid, e: [f"tx-{nid}-{i}" for i in range(4)])
+    batches = {nid: batches_of(net, nid)[0] for nid in net.correct_ids}
+    first = next(iter(batches.values()))
+    assert all(b == first for b in batches.values())
+    assert len(first.contribution_map()) >= net.node(0).netinfo.num_correct
+    for proposer, contrib in first.contribution_map().items():
+        assert contrib == [f"tx-{proposer}-{i}" for i in range(4)]
+    assert net.correct_faults() == []
+
+
+def test_multi_epoch_progression():
+    net = build_hb_net(n=4, seed=2, adversary=RandomAdversary())
+    run_epochs(net, 3, lambda nid, e: {"node": nid, "epoch": e})
+    for nid in net.correct_ids:
+        bs = batches_of(net, nid)
+        assert [b.epoch for b in bs[:3]] == [0, 1, 2]
+    ref = batches_of(net, net.correct_ids[0])[:3]
+    for nid in net.correct_ids[1:]:
+        assert batches_of(net, nid)[:3] == ref
+    # Contributions carry the right epoch (no cross-epoch leakage).
+    for b in ref:
+        for _, contrib in b.contributions:
+            assert contrib["epoch"] == b.epoch
+
+
+@pytest.mark.parametrize(
+    "schedule",
+    [EncryptionSchedule.never(), EncryptionSchedule.every_nth(2), EncryptionSchedule.tick_tock(1)],
+)
+def test_encryption_schedules(schedule):
+    net = build_hb_net(n=4, seed=3, schedule=schedule)
+    run_epochs(net, 2, lambda nid, e: (nid, e))
+    ref = batches_of(net, 0)[:2]
+    for nid in net.correct_ids[1:]:
+        assert batches_of(net, nid)[:2] == ref
+    assert net.correct_faults() == []
+
+
+@pytest.mark.slow
+def test_sixteen_nodes_256_tx():
+    # Benchmark-config-3 shape: 16 nodes, 256 txns split across proposers.
+    net = build_hb_net(n=16, seed=4)
+    per_node = 256 // 16
+    run_epochs(
+        net, 1, lambda nid, e: [f"tx-{nid}-{i}" for i in range(per_node)]
+    )
+    ref = batches_of(net, 0)[0]
+    committed = [tx for _, txs in ref.contributions for tx in txs]
+    assert len(committed) >= per_node * net.node(0).netinfo.num_correct
+    for nid in net.correct_ids[1:]:
+        assert batches_of(net, nid)[0] == ref
+    assert net.correct_faults() == []

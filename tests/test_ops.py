@@ -1,0 +1,89 @@
+"""GF(256)/Reed-Solomon and Merkle unit tests."""
+
+import random
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops.gf256 import (
+    EXP,
+    LOG,
+    ReedSolomon,
+    encoding_matrix,
+    gf_inv,
+    gf_mat_inv,
+    gf_matmul,
+    gf_mul,
+)
+from hbbft_tpu.ops.merkle import MerkleTree, Proof
+
+
+def test_gf_field_laws():
+    rng = random.Random(0)
+    for _ in range(200):
+        a, b, c = rng.randrange(256), rng.randrange(256), rng.randrange(256)
+        assert gf_mul(a, b) == gf_mul(b, a)
+        assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+
+
+def test_gf_matrix_inverse():
+    rng = np.random.RandomState(1)
+    for n in (1, 3, 8):
+        while True:
+            m = rng.randint(0, 256, size=(n, n)).astype(np.uint8)
+            try:
+                inv = gf_mat_inv(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf_matmul(m, inv), np.eye(n, dtype=np.uint8))
+
+
+def test_encoding_matrix_systematic_and_mds():
+    k, n = 4, 10
+    m = encoding_matrix(k, n)
+    assert np.array_equal(m[:k], np.eye(k, dtype=np.uint8))
+    # MDS property: every k-row submatrix is invertible (spot check many).
+    rng = random.Random(2)
+    import itertools
+
+    for rows in itertools.islice(itertools.combinations(range(n), k), 50):
+        gf_mat_inv(m[list(rows)])  # raises if singular
+
+
+@pytest.mark.parametrize("k,n", [(1, 1), (2, 4), (4, 10), (22, 64)])
+def test_rs_roundtrip(k, n):
+    rng = random.Random(k * 100 + n)
+    data = [bytes(rng.randrange(256) for _ in range(33)) for _ in range(k)]
+    rs = ReedSolomon(k, n)
+    shards = rs.encode(data)
+    assert shards[:k] == data  # systematic
+    # Reconstruct from a random k-subset (worst case: all parity).
+    idxs = rng.sample(range(n), k)
+    rec = rs.reconstruct({i: shards[i] for i in idxs})
+    assert rec == data
+    if n - k >= 1:
+        rec2 = rs.reconstruct({i: shards[i] for i in range(n - k, n)})
+        assert rec2 == data
+
+
+def test_merkle_proofs():
+    leaves = [f"shard-{i}".encode() for i in range(10)]
+    tree = MerkleTree(leaves)
+    for i in range(10):
+        p = tree.proof(i)
+        assert p.validate(10)
+        assert p.root == tree.root
+    # Tampered value / index / path all fail.
+    p = tree.proof(3)
+    assert not Proof(b"evil", p.index, p.path, p.root).validate(10)
+    assert not Proof(p.value, 4, p.path, p.root).validate(10)
+    assert not Proof(p.value, p.index, p.path[:-1], p.root).validate(10)
+    assert not Proof(p.value, p.index, p.path, b"\x00" * 32).validate(10)
+    # Single-leaf tree edge case.
+    t1 = MerkleTree([b"only"])
+    assert t1.proof(0).validate(1)

@@ -1,0 +1,62 @@
+"""Subset (ACS) tests.
+
+Reference analog: upstream ``tests/subset.rs``: all correct nodes output
+the identical set of contributions, containing at least N - f proposals,
+including every correct proposer that got in.
+"""
+
+import pytest
+
+from hbbft_tpu.net import NetBuilder, NullAdversary, RandomAdversary, ReorderingAdversary
+from hbbft_tpu.protocols.subset import Subset, SubsetOutput
+
+
+def run_subset(n=4, seed=0, adversary=None, inputs=None):
+    b = NetBuilder(n, seed=seed).protocol(
+        lambda ni, sink, rng: Subset(ni, b"acs-0", sink)
+    )
+    if adversary is not None:
+        b = b.adversary(adversary)
+    net = b.build()
+    inputs = inputs or {nid: f"contrib-{nid}".encode() for nid in net.correct_ids}
+    for nid, v in inputs.items():
+        net.send_input(nid, v)
+    net.run_to_termination(max_cranks=500_000)
+    results = {}
+    for nid in net.correct_ids:
+        contribs = {
+            o.proposer: o.value
+            for o in net.node(nid).outputs
+            if o.kind == "contribution"
+        }
+        assert net.node(nid).outputs[-1] == SubsetOutput.done()
+        results[nid] = contribs
+    return net, results
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize(
+    "adversary_cls", [NullAdversary, ReorderingAdversary, RandomAdversary]
+)
+def test_all_agree_on_subset(seed, adversary_cls):
+    net, results = run_subset(n=4, seed=seed, adversary=adversary_cls())
+    first = next(iter(results.values()))
+    assert all(r == first for r in results.values()), results
+    assert len(first) >= net.node(0).netinfo.num_correct
+    for pid, value in first.items():
+        assert value == f"contrib-{pid}".encode()
+    assert net.correct_faults() == []
+
+
+def test_seven_nodes_with_silent_faulty():
+    net, results = run_subset(n=7, seed=11)
+    first = next(iter(results.values()))
+    assert all(r == first for r in results.values())
+    # The two crash-faulty nodes never proposed; at least N - f accepted.
+    assert len(first) >= 5
+    assert net.correct_faults() == []
+
+
+def test_single_node_subset():
+    net, results = run_subset(n=1, seed=0)
+    assert results[0] == {0: b"contrib-0"}
