@@ -68,6 +68,57 @@ class CryptoBackend(abc.ABC):
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]: ...
 
 
+def request_well_formed(suite: Suite, req: VerifyRequest) -> bool:
+    """Structural validation of a request built from wire data.
+
+    Byzantine peers can put arbitrary objects where group elements belong;
+    anything that fails this check verifies as False instead of crashing
+    the batch.  Full (subgroup) membership checks run only on the
+    *wire-sourced* element of each request — the share itself, or the
+    ciphertext points of a CIPHERTEXT check.  The public-key share is
+    always derived locally from ``NetworkInfo`` and the ciphertext of a
+    DEC_SHARE request was already vetted by a prior CIPHERTEXT request
+    (``ThresholdDecrypt`` gates share submission on ciphertext validity),
+    so those get the cheap structural check.
+    """
+    if req.kind not in (SIG_SHARE, DEC_SHARE, CIPHERTEXT):
+        raise ValueError(f"unknown request kind {req.kind!r}")  # local bug
+    try:
+        if req.kind == SIG_SHARE:
+            pk, msg, share = req.payload
+            return (
+                isinstance(pk, PublicKeyShare)
+                and suite.is_g1(pk.g1, check_subgroup=False)
+                and isinstance(msg, bytes)
+                and isinstance(share, SignatureShare)
+                and suite.is_g2(share.g2)
+            )
+        if req.kind == DEC_SHARE:
+            pk, ct, share = req.payload
+            return (
+                isinstance(pk, PublicKeyShare)
+                and suite.is_g1(pk.g1, check_subgroup=False)
+                and _ciphertext_well_formed(suite, ct, check_subgroup=False)
+                and isinstance(share, DecryptionShare)
+                and suite.is_g1(share.g1)
+            )
+        (ct,) = req.payload
+        return _ciphertext_well_formed(suite, ct)
+    except Exception:
+        return False
+
+
+def _ciphertext_well_formed(
+    suite: Suite, ct: Any, check_subgroup: bool = True
+) -> bool:
+    return (
+        isinstance(ct, Ciphertext)
+        and suite.is_g1(ct.u, check_subgroup=check_subgroup)
+        and isinstance(ct.v, bytes)
+        and suite.is_g2(ct.w, check_subgroup=check_subgroup)
+    )
+
+
 class EagerBackend(CryptoBackend):
     """Per-item verification through the suite — the trusted slow path."""
 
@@ -77,17 +128,17 @@ class EagerBackend(CryptoBackend):
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
         out = []
         for r in reqs:
-            if r.kind == SIG_SHARE:
+            if not request_well_formed(self.suite, r):
+                out.append(False)
+            elif r.kind == SIG_SHARE:
                 pk, msg, share = r.payload
                 out.append(pk.verify_share(msg, share))
             elif r.kind == DEC_SHARE:
                 pk, ct, share = r.payload
                 out.append(pk.verify_decryption_share(ct, share))
-            elif r.kind == CIPHERTEXT:
+            else:
                 (ct,) = r.payload
                 out.append(ct.verify())
-            else:
-                raise ValueError(f"unknown request kind {r.kind}")
         return out
 
 
@@ -188,7 +239,12 @@ class BatchedBackend(CryptoBackend):
         if not reqs:
             return []
         out = [False] * len(reqs)
-        self._verify_range(reqs, list(range(len(reqs))), out)
+        # Malformed requests fail immediately and never enter the RLC
+        # algebra (where arbitrary objects could raise mid-aggregation).
+        idxs = [
+            i for i, r in enumerate(reqs) if request_well_formed(self.suite, r)
+        ]
+        self._verify_range(reqs, idxs, out)
         return out
 
     def _aggregate_ok(self, reqs: Sequence[VerifyRequest]) -> bool:

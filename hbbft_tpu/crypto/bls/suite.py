@@ -122,6 +122,32 @@ class BLSSuite(Suite):
     def g2_identity(self) -> G2Elem:
         return G2Elem(C.jac_identity(C.FQ2_OPS))
 
+    def is_g1(self, obj: Any, check_subgroup: bool = True) -> bool:
+        """Membership: structure, on-curve, and (optionally) r-torsion.
+
+        Byzantine peers can hand us arbitrary point objects; the subgroup
+        check defeats small-subgroup confinement of the RLC batch
+        verification (a torsion component could otherwise cancel with
+        noticeable probability).  Cost (one scalar mult) is acceptable in
+        this oracle backend; the TPU backend batches the same check.
+        """
+        return (
+            isinstance(obj, G1Elem)
+            and _coords_valid(obj.jac, fq2=False)
+            and _on_curve_and_torsion(
+                C.FQ_OPS, obj.jac, C.g1_on_curve, check_subgroup
+            )
+        )
+
+    def is_g2(self, obj: Any, check_subgroup: bool = True) -> bool:
+        return (
+            isinstance(obj, G2Elem)
+            and _coords_valid(obj.jac, fq2=True)
+            and _on_curve_and_torsion(
+                C.FQ2_OPS, obj.jac, C.g2_on_curve, check_subgroup
+            )
+        )
+
     def hash_to_g2(self, data: bytes) -> G2Elem:
         return G2Elem(C.hash_to_g2(bytes(data)))
 
@@ -130,3 +156,33 @@ class BLSSuite(Suite):
     ) -> bool:
         aff_pairs = [(a.affine(), b.affine()) for a, b in pairs]
         return PR.multi_pairing_is_one(aff_pairs)
+
+
+def _fq_valid(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < F.P
+
+
+def _fq2_valid(v: Any) -> bool:
+    return (
+        isinstance(v, tuple) and len(v) == 2 and _fq_valid(v[0]) and _fq_valid(v[1])
+    )
+
+
+def _coords_valid(jac: Any, fq2: bool) -> bool:
+    if not (isinstance(jac, tuple) and len(jac) == 3):
+        return False
+    check = _fq2_valid if fq2 else _fq_valid
+    return all(check(c) for c in jac)
+
+
+def _on_curve_and_torsion(
+    ops: C.FieldOps, jac: C.Jac, on_curve, check_subgroup: bool
+) -> bool:
+    if C.jac_is_identity(ops, jac):
+        return True
+    aff = C.jac_to_affine(ops, jac)
+    if aff is None or not on_curve(aff[0], aff[1]):
+        return False
+    if not check_subgroup:
+        return True
+    return C.jac_is_identity(ops, C.jac_mul(ops, jac, F.R))

@@ -45,6 +45,20 @@ class Suite(abc.ABC):
     @abc.abstractmethod
     def g2_identity(self) -> Any: ...
 
+    # -- membership ---------------------------------------------------
+    @abc.abstractmethod
+    def is_g1(self, obj: Any, check_subgroup: bool = True) -> bool:
+        """Whether ``obj`` is a G1 element of this suite (wire validation).
+
+        ``check_subgroup=False`` skips the expensive r-torsion check for
+        elements that are locally derived (trusted) rather than
+        wire-sourced.
+        """
+
+    @abc.abstractmethod
+    def is_g2(self, obj: Any, check_subgroup: bool = True) -> bool:
+        """Whether ``obj`` is a G2 element of this suite (wire validation)."""
+
     # -- hashing ------------------------------------------------------
     @abc.abstractmethod
     def hash_to_g2(self, data: bytes) -> Any:
@@ -115,6 +129,18 @@ class ScalarSuite(Suite):
 
     def g2_identity(self) -> ScalarG:
         return ScalarG(0, self.scalar_modulus)
+
+    def is_g1(self, obj: Any, check_subgroup: bool = True) -> bool:
+        return (
+            isinstance(obj, ScalarG)
+            and isinstance(obj.value, int)
+            and not isinstance(obj.value, bool)
+            and obj.modulus == self.scalar_modulus
+            and 0 <= obj.value < obj.modulus
+        )
+
+    def is_g2(self, obj: Any, check_subgroup: bool = True) -> bool:
+        return self.is_g1(obj)
 
     def hash_to_g2(self, data: bytes) -> ScalarG:
         h = hashlib.sha3_256(canonical_bytes(b"h2g2", data)).digest()

@@ -29,12 +29,26 @@ class Proof:
     path: Tuple[bytes, ...]
     root: bytes
 
+    def well_formed(self) -> bool:
+        """Structural check — fields may be arbitrary Byzantine objects."""
+        return (
+            isinstance(self.value, bytes)
+            and isinstance(self.index, int)
+            and not isinstance(self.index, bool)
+            and isinstance(self.path, tuple)
+            and all(isinstance(p, bytes) and len(p) == 32 for p in self.path)
+            and isinstance(self.root, bytes)
+            and len(self.root) == 32
+        )
+
     def validate(self, n_leaves: int) -> bool:
         """Check the path hashes from ``value`` up to ``root``.
 
         ``n_leaves`` bounds the expected path length so a forged deeper/
         shallower proof is rejected.
         """
+        if not self.well_formed():
+            return False
         if not 0 <= self.index < n_leaves:
             return False
         if len(self.path) != _depth(n_leaves):

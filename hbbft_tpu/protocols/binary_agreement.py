@@ -29,6 +29,17 @@ from hbbft_tpu.utils import canonical_bytes
 
 FAULT_DUPLICATE_CONF = "binary_agreement:duplicate-conf"
 FAULT_DUPLICATE_TERM = "binary_agreement:duplicate-term"
+FAULT_MALFORMED = "binary_agreement:malformed-message"
+
+
+def _content_well_formed(content: Any) -> bool:
+    if isinstance(content, (BValMsg, AuxMsg, TermMsg)):
+        return isinstance(content.value, bool)
+    if isinstance(content, ConfMsg):
+        return isinstance(content.vals, BoolSet)
+    if isinstance(content, CoinMsg):
+        return isinstance(content.inner, SignMessage)
+    return False
 
 MAX_FUTURE_ROUNDS = 100  # bound per-sender buffering of rounds ahead of us
 
@@ -108,6 +119,13 @@ class BinaryAgreement(ConsensusProtocol):
 
     def handle_message(self, sender: Any, message: AbaMessage, rng: Any) -> Step:
         step = Step.empty()
+        if (
+            not isinstance(message, AbaMessage)
+            or not isinstance(message.round, int)
+            or isinstance(message.round, bool)
+            or not _content_well_formed(message.content)
+        ):
+            return step.fault(sender, FAULT_MALFORMED)
         content = message.content
         if isinstance(content, TermMsg):
             return self._handle_term(sender, content.value)

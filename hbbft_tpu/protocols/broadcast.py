@@ -33,6 +33,7 @@ FAULT_NOT_PROPOSER = "broadcast:value-from-non-proposer"
 FAULT_MULTIPLE_VALUES = "broadcast:multiple-values"
 FAULT_DUPLICATE = "broadcast:duplicate-message"
 FAULT_BAD_ENCODING = "broadcast:root-mismatch-after-decode"
+FAULT_MALFORMED = "broadcast:malformed-message"
 
 
 @dataclass(frozen=True)
@@ -124,12 +125,18 @@ class Broadcast(ConsensusProtocol):
         if isinstance(message, ValueMsg):
             if sender != self._proposer:
                 return step.fault(sender, FAULT_NOT_PROPOSER)
+            if not isinstance(message.proof, Proof) or not message.proof.well_formed():
+                return step.fault(sender, FAULT_MALFORMED)
             return self._handle_value(sender, message.proof)
         if isinstance(message, EchoMsg):
+            if not isinstance(message.proof, Proof) or not message.proof.well_formed():
+                return step.fault(sender, FAULT_MALFORMED)
             return self._handle_echo(sender, message.proof)
         if isinstance(message, ReadyMsg):
+            if not isinstance(message.root, bytes):
+                return step.fault(sender, FAULT_MALFORMED)
             return self._handle_ready(sender, message.root)
-        return step.fault(sender, FAULT_DUPLICATE)
+        return step.fault(sender, FAULT_MALFORMED)
 
     # -- internals -----------------------------------------------------
     def _handle_value(self, sender: Any, proof: Proof) -> Step:

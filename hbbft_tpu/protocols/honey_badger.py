@@ -30,6 +30,14 @@ from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 from hbbft_tpu.utils import canonical_bytes, serde
 
 FAULT_FUTURE_EPOCH = "honey_badger:message-beyond-max-future-epochs"
+FAULT_MALFORMED = "honey_badger:malformed-message"
+FAULT_FLOOD = "honey_badger:future-epoch-flood"
+
+# Per-sender cap on buffered future-epoch messages.  An honest node sends
+# O(N) Subset messages plus a bounded number of ABA/decrypt messages per
+# epoch; the multiplier is generous so slow-but-honest peers never hit it,
+# while a Byzantine flooder cannot grow memory without bound.
+_FUTURE_BUFFER_PER_SENDER_FACTOR = 64
 FAULT_BAD_CIPHERTEXT = "honey_badger:invalid-ciphertext"
 FAULT_BAD_CONTRIBUTION = "honey_badger:undecodable-contribution"
 
@@ -267,6 +275,7 @@ class HoneyBadger(ConsensusProtocol):
         self._epoch = 0
         self._state = _EpochState(self, 0)
         self._future: Dict[int, List[Tuple[Any, HbMessage]]] = {}
+        self._future_per_sender: Dict[Any, int] = {}
         self._pending_proposal: Optional[Any] = None
 
     # -- ConsensusProtocol --------------------------------------------
@@ -314,11 +323,27 @@ class HoneyBadger(ConsensusProtocol):
 
     def handle_message(self, sender: Any, message: HbMessage, rng: Any) -> Step:
         step = Step.empty()
+        if (
+            not isinstance(message, HbMessage)
+            or not isinstance(message.epoch, int)
+            or isinstance(message.epoch, bool)
+            or message.kind not in (SUBSET, DECRYPT)
+        ):
+            return step.fault(sender, FAULT_MALFORMED)
         if message.epoch < self._epoch:
             return step  # stale epoch: drop
         if message.epoch > self._epoch + self.max_future_epochs:
             return step.fault(sender, FAULT_FUTURE_EPOCH)
         if message.epoch > self._epoch:
+            cap = (
+                _FUTURE_BUFFER_PER_SENDER_FACTOR
+                * (self.max_future_epochs + 1)
+                * max(1, self._netinfo.num_nodes)
+            )
+            buffered = self._future_per_sender.get(sender, 0)
+            if buffered >= cap:
+                return step.fault(sender, FAULT_FLOOD)
+            self._future_per_sender[sender] = buffered + 1
             self._future.setdefault(message.epoch, []).append((sender, message))
             return step
         step.extend(self._state.handle_message(sender, message, rng))
@@ -343,5 +368,10 @@ class HoneyBadger(ConsensusProtocol):
                 step.extend(self._propose_now(proposal, prop_rng))
             replay = self._future.pop(self._epoch, [])
             for sender, msg in replay:
+                remaining = self._future_per_sender.get(sender, 1) - 1
+                if remaining > 0:
+                    self._future_per_sender[sender] = remaining
+                else:
+                    self._future_per_sender.pop(sender, None)
                 step.extend(self._state.handle_message(sender, msg, rng))
         return step

@@ -108,7 +108,13 @@ class Subset(ConsensusProtocol):
         step = Step.empty()
         if self._terminated:
             return step
-        if message.proposer not in self._proposals:
+        if not isinstance(message, SubsetMessage):
+            return step.fault(sender, FAULT_BAD_MESSAGE)
+        try:
+            known = message.proposer in self._proposals
+        except TypeError:  # unhashable garbage proposer
+            known = False
+        if not known:
             return step.fault(sender, FAULT_UNKNOWN_PROPOSER)
         prop = self._proposals[message.proposer]
         if message.kind == BC:

@@ -24,6 +24,7 @@ from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 FAULT_INVALID_SHARE = "threshold_decrypt:invalid-share"
 FAULT_NON_VALIDATOR = "threshold_decrypt:non-validator"
 FAULT_DUPLICATE = "threshold_decrypt:duplicate-share"
+FAULT_MALFORMED = "threshold_decrypt:malformed-message"
 
 
 @dataclass(frozen=True)
@@ -83,6 +84,10 @@ class ThresholdDecrypt(ConsensusProtocol):
             return step
         if not self._netinfo.is_node_validator(sender):
             return step.fault(sender, FAULT_NON_VALIDATOR)
+        if not isinstance(message, DecryptMessage) or not isinstance(
+            message.share, DecryptionShare
+        ):
+            return step.fault(sender, FAULT_MALFORMED)
         if sender in self._seen:
             return step.fault(sender, FAULT_DUPLICATE)
         self._seen.add(sender)

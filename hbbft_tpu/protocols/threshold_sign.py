@@ -27,6 +27,7 @@ from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 FAULT_INVALID_SHARE = "threshold_sign:invalid-share"
 FAULT_NON_VALIDATOR = "threshold_sign:non-validator"
 FAULT_DUPLICATE = "threshold_sign:duplicate-share"
+FAULT_MALFORMED = "threshold_sign:malformed-message"
 
 
 @dataclass(frozen=True)
@@ -89,6 +90,10 @@ class ThresholdSign(ConsensusProtocol):
             return step
         if not self._netinfo.is_node_validator(sender):
             return step.fault(sender, FAULT_NON_VALIDATOR)
+        if not isinstance(message, SignMessage) or not isinstance(
+            message.share, SignatureShare
+        ):
+            return step.fault(sender, FAULT_MALFORMED)
         if sender in self._seen:
             return step.fault(sender, FAULT_DUPLICATE)
         self._seen.add(sender)

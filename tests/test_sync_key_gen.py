@@ -1,0 +1,267 @@
+"""SyncKeyGen (DKG) tests.
+
+Mirrors upstream ``src/sync_key_gen.rs`` doc-tests / ``tests/sync_key_gen.rs``
+(SURVEY.md §2 #12, §4): full-participation key generation, threshold
+signing with the generated keys, observer support, and resilience to a
+dealer that corrupts a single node's row.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.keys import SecretKey
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.protocols.sync_key_gen import (
+    FAULT_BAD_ACK,
+    FAULT_BAD_PART,
+    Ack,
+    Part,
+    SyncKeyGen,
+)
+
+SUITE = ScalarSuite()
+
+
+def _setup(n, seed=7):
+    rng = random.Random(seed)
+    sks = {i: SecretKey.random(rng, SUITE) for i in range(n)}
+    pks = {i: sks[i].public_key() for i in range(n)}
+    return rng, sks, pks
+
+
+def _run_dkg(n, threshold, seed=7, corrupt=None, observer=False):
+    """Full in-process DKG; ``corrupt(dealer, part, rng)`` may rewrite parts."""
+    rng, sks, pks = _setup(n, seed)
+    nodes = {}
+    parts = {}
+    ids = list(range(n)) + (["obs"] if observer else [])
+    for i in ids:
+        sk = sks.get(i) or SecretKey.random(rng, SUITE)
+        skg, part = SyncKeyGen.new(i, sk, pks, threshold, rng, SUITE)
+        nodes[i] = skg
+        if part is not None:
+            parts[i] = part
+    assert observer is False or "obs" not in parts
+
+    acks = []
+    for dealer in sorted(parts):
+        part = parts[dealer]
+        if corrupt is not None:
+            part = corrupt(dealer, part, rng) or part
+        for i in ids:
+            outcome = nodes[i].handle_part(dealer, part, rng)
+            if outcome.ack is not None:
+                acks.append((i, outcome.ack))
+    for sender, ack in acks:
+        for i in ids:
+            nodes[i].handle_ack(sender, ack)
+    return nodes, rng
+
+
+def test_full_dkg_generates_working_threshold_keys():
+    n, t = 4, 1
+    nodes, rng = _run_dkg(n, t)
+    for skg in nodes.values():
+        assert skg.is_ready()
+        assert skg.count_complete() == n
+
+    results = {i: skg.generate() for i, skg in nodes.items()}
+    pk_bytes = {r[0].to_bytes() for r in results.values()}
+    assert len(pk_bytes) == 1, "all nodes derive the same PublicKeySet"
+
+    pk_set = results[0][0]
+    assert pk_set.threshold == t
+    msg = b"dkg signing test"
+    shares = {i: results[i][1].sign(msg) for i in range(t + 1)}
+    sig = pk_set.combine_signatures(shares)
+    assert pk_set.public_key().verify(msg, sig)
+    # Any other t+1 subset combines to the same signature.
+    shares2 = {i: results[i][1].sign(msg) for i in range(2, 2 + t + 1)}
+    sig2 = pk_set.combine_signatures(shares2)
+    assert sig.to_bytes() == sig2.to_bytes()
+
+
+def test_share_matches_public_key_share():
+    n, t = 7, 2
+    nodes, _ = _run_dkg(n, t, seed=11)
+    pk_set, _ = nodes[0].generate()
+    for i in range(n):
+        _, share = nodes[i].generate()
+        expected = pk_set.public_key_share(i)
+        assert (SUITE.g1_generator() * share.x).to_bytes() == expected.to_bytes()
+
+
+def test_observer_tracks_public_key_but_gets_no_share():
+    n, t = 4, 1
+    nodes, _ = _run_dkg(n, t, observer=True)
+    pk_set, share = nodes["obs"].generate()
+    assert share is None
+    ref_pk, _ = nodes[0].generate()
+    assert pk_set.to_bytes() == ref_pk.to_bytes()
+
+
+def test_dealer_corrupting_one_row_is_detected_and_tolerated():
+    n, t = 4, 1
+    victim = 0
+    evil_dealer = 3
+    faults = []
+
+    def corrupt(dealer, part, rng):
+        if dealer != evil_dealer:
+            return part
+        # Replace the victim's encrypted row with garbage bytes.
+        rows = list(part.rows)
+        rng2 = random.Random(99)
+        from hbbft_tpu.crypto.keys import SecretKey
+
+        bogus_pk = SecretKey.random(rng2, SUITE).public_key()
+        rows[victim] = bogus_pk.encrypt(b"garbage", rng2)
+        return Part(part.commitment, tuple(rows))
+
+    rng, sks, pks = _setup(n)
+    nodes = {}
+    parts = {}
+    for i in range(n):
+        skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+        nodes[i] = skg
+        parts[i] = part
+
+    acks = []
+    for dealer in sorted(parts):
+        part = corrupt(dealer, parts[dealer], rng)
+        for i in range(n):
+            outcome = nodes[i].handle_part(dealer, part, rng)
+            if not outcome.is_valid:
+                faults.append((i, dealer, outcome.fault))
+            if outcome.ack is not None:
+                acks.append((i, outcome.ack))
+    for sender, ack in acks:
+        for i in range(n):
+            nodes[i].handle_ack(sender, ack)
+
+    # The victim flagged the dealer...
+    assert (victim, evil_dealer, FAULT_BAD_PART) in faults
+    # ...but the proposal still completed via the other nodes' acks
+    # (n-1 = 3 = 2t+1 acks), and the victim recovers its share from them.
+    assert all(skg.is_node_ready(evil_dealer) for skg in nodes.values())
+    results = {i: nodes[i].generate() for i in range(n)}
+    assert len({r[0].to_bytes() for r in results.values()}) == 1
+    pk_set = results[victim][0]
+    msg = b"still works"
+    shares = {i: results[i][1].sign(msg) for i in (victim, 1)}
+    sig = pk_set.combine_signatures(shares)
+    assert pk_set.public_key().verify(msg, sig)
+
+
+def test_forged_ack_value_is_rejected():
+    n, t = 4, 1
+    rng, sks, pks = _setup(n)
+    nodes = {}
+    parts = {}
+    for i in range(n):
+        skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+        nodes[i] = skg
+        parts[i] = part
+    # Node 0 handles dealer 1's part and produces a genuine ack...
+    out = nodes[0].handle_part(1, parts[1], rng)
+    ack = out.ack
+    nodes[2].handle_part(1, parts[1], rng)
+    # ...which an attacker rewrites with wrong encrypted values.
+    forged_values = tuple(
+        pks[i].encrypt(b"\x00" * 8, rng) for i in range(n)
+    )
+    forged = Ack(ack.proposer, forged_values)
+    outcome = nodes[2].handle_ack(0, forged)
+    assert outcome.fault == FAULT_BAD_ACK
+
+
+def test_bad_ack_value_still_counts_publicly_no_key_divergence():
+    """Regression: ack acceptance must depend only on public data.
+
+    A Byzantine acker that corrupts exactly one node's encrypted value
+    slot must not make ack sets — and hence the generated keys — diverge
+    across nodes.
+    """
+    n, t = 4, 1
+    evil = 3
+    victim = 1
+    rng, sks, pks = _setup(n, seed=5)
+    nodes = {}
+    parts = {}
+    for i in range(n):
+        skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+        nodes[i] = skg
+        parts[i] = part
+
+    acks = []
+    for dealer in sorted(parts):
+        for i in range(n):
+            out = nodes[i].handle_part(dealer, parts[dealer], rng)
+            if out.ack is not None:
+                ack = out.ack
+                if i == evil:
+                    # Corrupt only the victim's slot with a wrong value.
+                    vals = list(ack.values)
+                    vals[victim] = pks[victim].encrypt(
+                        __import__("hbbft_tpu.utils.serde", fromlist=["serde"]).dumps(12345),
+                        rng,
+                    )
+                    ack = Ack(ack.proposer, tuple(vals))
+                acks.append((i, ack))
+    fault_seen = False
+    for sender, ack in acks:
+        for i in range(n):
+            out = nodes[i].handle_ack(sender, ack)
+            if not out.is_valid:
+                assert i == victim and sender == evil
+                fault_seen = True
+    assert fault_seen, "victim must detect the corrupted ack value"
+
+    # Ack sets are identical everywhere -> identical keys and usable shares.
+    results = {i: nodes[i].generate() for i in range(n)}
+    assert len({r[0].to_bytes() for r in results.values()}) == 1
+    pk_set = results[victim][0]
+    msg = b"no divergence"
+    shares = {i: results[i][1].sign(msg) for i in (victim, 2)}
+    assert pk_set.public_key().verify(msg, pk_set.combine_signatures(shares))
+
+
+def test_malformed_part_and_ack_fault_instead_of_crash():
+    n, t = 4, 1
+    rng, sks, pks = _setup(n)
+    skg, part = SyncKeyGen.new(0, sks[0], pks, t, rng, SUITE)
+
+    from hbbft_tpu.crypto.poly import BivarCommitment
+
+    bad_parts = [
+        42,
+        Part(commitment="junk", rows=(1, 2, 3, 4)),
+        Part(commitment=BivarCommitment(elems=5), rows=part.rows),
+        Part(part.commitment, rows=("a",) * 4),
+        Part(part.commitment, rows=part.rows[:2]),
+    ]
+    for bad in bad_parts:
+        out = skg.handle_part(1, bad, rng)
+        assert out.fault == FAULT_BAD_PART, bad
+
+    skg.handle_part(0, part, rng)
+    bad_acks = [
+        "junk",
+        Ack(proposer=[], values=part.rows),  # unhashable proposer
+        Ack(proposer=0, values=5),
+        Ack(proposer=0, values=("x",) * 4),
+        Ack(proposer=0, values=part.rows[:1]),
+    ]
+    for bad in bad_acks:
+        out = skg.handle_ack(1, bad)
+        assert not out.is_valid, bad
+
+
+def test_not_ready_generate_raises():
+    n, t = 4, 1
+    rng, sks, pks = _setup(n)
+    skg, _part = SyncKeyGen.new(0, sks[0], pks, t, rng, SUITE)
+    assert not skg.is_ready()
+    with pytest.raises(RuntimeError):
+        skg.generate()
