@@ -1,0 +1,80 @@
+"""Headline benchmark: BLS signature-share verifies/sec on one chip.
+
+BASELINE.json:2 metric ("sig-share verifies/sec/chip").  The reference
+(zhaohanjin/hbbft + threshold_crypto, pure Rust) verifies each share with
+one pairing equality on a CPU core — ~10^3 verifies/sec/core (BASELINE.md
+§6, PAPERS.md EdDSA/BLS-in-consensus measurements).  This bench runs the
+TPU path: N same-message shares RLC-collapsed into batched 128-bit scalar
+multiplications plus two pairings, all on device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is measured against this machine's own single-thread
+pure-Python-free CPU estimate; the reference publishes no numbers
+(BASELINE.json:13 "published": {}), so the CPU pairing-rate proxy
+(1000 verifies/sec, the literature figure for one core) is the anchor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from hbbft_tpu.utils.jaxcache import enable_cache
+
+enable_cache()
+
+import random
+
+from hbbft_tpu.crypto.backend import VerifyRequest
+from hbbft_tpu.crypto.bls.suite import BLSSuite
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.crypto.tpu.backend import TpuBackend
+
+# Literature CPU rate for one-pairing-per-share verification on one core
+# (~0.5-1.5 ms/pairing; PAPERS.md arxiv 2302.00418). No published
+# reference numbers exist to compare against (BASELINE.json:13).
+CPU_BASELINE_VERIFIES_PER_SEC = 1000.0
+
+
+def main() -> None:
+    n_shares = int(os.environ.get("BENCH_SHARES", "512"))
+    suite = BLSSuite()
+    rng = random.Random(7)
+    sks = SecretKeySet.random(2, rng, suite)
+    pks = sks.public_keys()
+    msg = b"hbbft-tpu benchmark epoch document"
+    reqs = []
+    for i in range(n_shares):
+        share = sks.secret_key_share(i % 8).sign(msg)
+        reqs.append(VerifyRequest.sig_share(pks.public_key_share(i % 8), msg, share))
+
+    backend = TpuBackend(suite)
+    # Warmup on the SAME shape bucket: compiles the flush kernel once
+    # (cached on disk afterwards), so the timed run measures execution.
+    warm = backend.verify_batch(reqs)
+    assert all(warm), "warmup verification failed"
+
+    t0 = time.perf_counter()
+    results = backend.verify_batch(reqs)
+    dt = time.perf_counter() - t0
+    assert all(results), "benchmark verification failed"
+
+    rate = n_shares / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_sig_share_verifies_per_sec_per_chip",
+                "value": round(rate, 2),
+                "unit": "verifies/sec",
+                "vs_baseline": round(rate / CPU_BASELINE_VERIFIES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,9 +18,12 @@ Structure (TPU-first redesign, not a port):
   (BASELINE.json:5): batch verification of signature/decryption shares and
   ciphertexts, with random-linear-combination collapsing so a whole
   epoch's checks cost O(#distinct messages) pairings.
-* :mod:`~hbbft_tpu.crypto.tpu` — the JAX/TPU batched pairing backend
-  (in progress; ``BLSSuite`` and ``TpuBackend`` land in later milestones
-  of this build — until then only the suites above exist).
+* :mod:`~hbbft_tpu.crypto.tpu` — the JAX/TPU device path: int32-limb
+  Montgomery field arithmetic, batched G1/G2 Jacobian ops and scalar
+  multiplication, the full optimal-ate pairing (Fq12 tower, scanned
+  Miller loop, chained final exponentiation), and ``TpuBackend`` — the
+  accelerator implementation of the RLC batch-verify contract.  Import
+  lazily (pulls in jax).
 """
 
 from hbbft_tpu.crypto.keys import (  # noqa: F401

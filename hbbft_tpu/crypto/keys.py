@@ -209,6 +209,14 @@ class PublicKeySet:
         self.commitment = commitment
         self.suite = suite
 
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, PublicKeySet):
+            return NotImplemented
+        return self.commitment == other.commitment and self.suite == other.suite
+
+    def __hash__(self) -> int:
+        return hash((self.commitment, self.suite))
+
     @property
     def threshold(self) -> int:
         return self.commitment.degree

@@ -27,10 +27,22 @@ from hbbft_tpu.utils import canonical_bytes
 
 
 class Suite(abc.ABC):
-    """A pairing-friendly group suite."""
+    """A pairing-friendly group suite.
+
+    Suites are stateless: two instances of the same class are the same
+    suite (value equality), so objects that carry a suite reference —
+    keys, ciphertexts, Changes — stay value-comparable across
+    serialization round-trips.
+    """
 
     name: str
     scalar_modulus: int  # order r of G1/G2
+
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
 
     # -- group elements ----------------------------------------------
     @abc.abstractmethod

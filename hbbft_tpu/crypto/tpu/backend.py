@@ -1,0 +1,207 @@
+"""TpuBackend: the north-star CryptoBackend (BASELINE.json:5).
+
+Same random-linear-combination batch verification as
+:class:`hbbft_tpu.crypto.backend.BatchedBackend` — identical Fiat-Shamir
+coefficients, identical leg algebra, bisection fallback on failure — but
+the heavy group algebra runs on the accelerator in ONE jitted kernel:
+
+* every share/key/ciphertext point is scaled by its 128-bit RLC
+  coefficient with a batched double-and-add scan (the whole batch rides
+  the vector lanes),
+* per-leg sums are masked tree reductions,
+* the 1 + L pairing-product legs run through the batched Miller loop and
+  one shared final exponentiation.
+
+Kernel shapes are bucketed to powers of two so recompilation is bounded;
+compiled kernels are cached per (n_g1, n_g2, n_legs) bucket.
+
+Replaces the per-share CPU pairing checks of upstream
+``threshold_crypto`` (``src/lib.rs`` verify paths; SURVEY.md §2 #14).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hbbft_tpu.crypto.backend import (
+    CIPHERTEXT,
+    DEC_SHARE,
+    SIG_SHARE,
+    CryptoBackend,
+    EagerBackend,
+    VerifyRequest,
+    _batch_coefficients,
+    request_well_formed,
+)
+from hbbft_tpu.crypto.bls import curve as ocurve
+from hbbft_tpu.crypto.bls.suite import BLSSuite
+from hbbft_tpu.crypto.tpu import curve as dcurve
+from hbbft_tpu.crypto.tpu import pairing as dpairing
+from hbbft_tpu.utils import canonical_bytes
+
+NBITS = 128  # RLC coefficient width
+
+
+def _bucket(n: int, floor: int = 16) -> int:
+    """Round up to a power of two (with a floor) to bound recompiles.
+
+    The floor matters for bisection: all small sub-batches pad to the
+    same shape and reuse one compiled kernel instead of compiling a
+    fresh kernel per subset size."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@lru_cache(maxsize=32)
+def _kernel(n_g1: int, n_g2: int, n_legs: int):
+    """Compiled flush kernel for one shape bucket.
+
+    Inputs (all device arrays):
+      g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, NBITS),
+      g1 leg one-hot (n_legs, n_g1);
+      g2 pts / bits (n_g2 …) — the generator leg;
+      rhs G2 points (n_legs) to pair each G1 leg sum with.
+    Returns the single aggregate boolean.
+    """
+
+    def run(g1_pts, g1_bits, seg, g2_pts, g2_bits, rhs_g2, gen_pt):
+        scaled1 = dcurve.scalar_mul(dcurve.G1_OPS, g1_pts, g1_bits)
+        scaled2 = dcurve.scalar_mul(dcurve.G2_OPS, g2_pts, g2_bits)
+        gen_leg = dcurve.tree_sum(dcurve.G2_OPS, scaled2)
+        leg_sums = []
+        for l in range(n_legs):
+            masked = dcurve.select(
+                seg[l], scaled1, dcurve.identity(dcurve.G1_OPS, (n_g1,)), dcurve.G1_OPS
+            )
+            leg_sums.append(dcurve.tree_sum(dcurve.G1_OPS, masked))
+        # Pair list: (gen, gen_leg) + (leg_sum_l, rhs_l).
+        lhs = tuple(
+            jnp.stack([gen_pt[c]] + [p[c] for p in leg_sums]) for c in range(4)
+        )
+        rhs = tuple(
+            jnp.concatenate([jnp.stack([gen_leg[c]]), rhs_g2[c]]) for c in range(4)
+        )
+        return dpairing.pairing_product_is_one(lhs, rhs)
+
+    return jax.jit(run)
+
+
+class TpuBackend(CryptoBackend):
+    """RLC batch verification with the group algebra on the accelerator."""
+
+    def __init__(self, suite: BLSSuite | None = None) -> None:
+        self.suite = suite or BLSSuite()
+        self._eager = EagerBackend(self.suite)
+
+    # -- leg construction (host, cheap): mirrors backend._rlc_pairs ----
+
+    def _build_legs(self, reqs: Sequence[VerifyRequest], coeffs: Sequence[int]):
+        """Returns (g2_entries, g1_entries, rhs_points).
+
+        g2_entries: list of (scalar, oracle G2 jac) summed against the G1
+        generator.  g1_entries: list of (scalar, oracle G1 jac, leg_id).
+        rhs_points[leg_id]: oracle G2 jac each G1 leg pairs with.
+        """
+        g2_entries: List[Tuple[int, Any]] = []
+        g1_entries: List[Tuple[int, Any, int]] = []
+        rhs: List[Any] = []
+        leg_of: Dict[bytes, int] = {}
+
+        def leg(key: bytes, point_jac: Any) -> int:
+            if key not in leg_of:
+                leg_of[key] = len(rhs)
+                rhs.append(point_jac)
+            return leg_of[key]
+
+        for r, c in zip(reqs, coeffs):
+            if r.kind == SIG_SHARE:
+                pk, msg, share = r.payload
+                g2_entries.append((c, share.g2.jac))
+                l = leg(canonical_bytes(b"m", msg), self.suite.hash_to_g2(msg).jac)
+                g1_entries.append((c, (-pk.g1).jac, l))
+            elif r.kind == DEC_SHARE:
+                pk, ct, share = r.payload
+                l = leg(
+                    canonical_bytes(b"c", ct.hash_input()),
+                    self.suite.hash_to_g2(ct.hash_input()).jac,
+                )
+                g1_entries.append((c, share.g1.jac, l))
+                lw = leg(canonical_bytes(b"w", ct.w.to_bytes()), ct.w.jac)
+                g1_entries.append((c, (-pk.g1).jac, lw))
+            else:
+                (ct,) = r.payload
+                g2_entries.append((c, ct.w.jac))
+                l = leg(
+                    canonical_bytes(b"c", ct.hash_input()),
+                    self.suite.hash_to_g2(ct.hash_input()).jac,
+                )
+                g1_entries.append((c, (-ct.u).jac, l))
+        return g2_entries, g1_entries, rhs
+
+    def _aggregate_ok(self, reqs: Sequence[VerifyRequest]) -> bool:
+        coeffs = _batch_coefficients(self.suite, reqs)
+        g2e, g1e, rhs = self._build_legs(reqs, coeffs)
+        n1 = _bucket(max(len(g1e), 1))
+        n2 = _bucket(max(len(g2e), 1))
+        # Legs become pairing-product pairs (a Miller loop each, even when
+        # identity-skipped), so keep their floor low.
+        nl = _bucket(max(len(rhs), 1), floor=2)
+        ident1 = (1, 1, 0)
+        ident2 = ((1, 0), (1, 0), (0, 0))
+        g1_pts = dcurve.g1_to_dev(
+            [p for _, p, _ in g1e] + [ident1] * (n1 - len(g1e))
+        )
+        g1_bits = dcurve.scalars_to_bits(
+            [s for s, _, _ in g1e] + [0] * (n1 - len(g1e)), NBITS
+        )
+        seg = np.zeros((nl, n1), dtype=np.int32)
+        for i, (_, _, l) in enumerate(g1e):
+            seg[l, i] = 1
+        g2_pts = dcurve.g2_to_dev(
+            [p for _, p in g2e] + [ident2] * (n2 - len(g2e))
+        )
+        g2_bits = dcurve.scalars_to_bits(
+            [s for s, _ in g2e] + [0] * (n2 - len(g2e)), NBITS
+        )
+        rhs_pts = dcurve.g2_to_dev(rhs + [ident2] * (nl - len(rhs)))
+        gen_pt = dcurve.g1_to_dev([ocurve.G1_GEN])
+        gen_pt = tuple(x[0] for x in gen_pt)
+        ok = _kernel(n1, n2, nl)(
+            g1_pts, g1_bits, jnp.asarray(seg), g2_pts, g2_bits, rhs_pts, gen_pt
+        )
+        return bool(ok)
+
+    # -- public API ----------------------------------------------------
+
+    def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
+        reqs = list(reqs)
+        if not reqs:
+            return []
+        out = [False] * len(reqs)
+        idxs = [i for i, r in enumerate(reqs) if request_well_formed(self.suite, r)]
+        self._verify_range(reqs, idxs, out)
+        return out
+
+    def _verify_range(
+        self, all_reqs: List[VerifyRequest], idxs: List[int], out: List[bool]
+    ) -> None:
+        if not idxs:
+            return
+        sub = [all_reqs[i] for i in idxs]
+        if self._aggregate_ok(sub):
+            for i in idxs:
+                out[i] = True
+            return
+        if len(idxs) == 1:
+            out[idxs[0]] = self._eager.verify_batch(sub)[0]
+            return
+        mid = len(idxs) // 2
+        self._verify_range(all_reqs, idxs[:mid], out)
+        self._verify_range(all_reqs, idxs[mid:], out)

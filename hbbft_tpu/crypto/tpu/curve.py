@@ -1,0 +1,263 @@
+"""Batched Jacobian point arithmetic for G1 (Fq) and G2 (Fq2) on TPU.
+
+Replaces the per-point CPU group ops of upstream ``threshold_crypto``
+(SURVEY.md §2 #14) with branch-free, vmappable formulas.
+
+Point representation: ``(x, y, z, inf)`` — Jacobian coordinates as limb
+arrays plus an explicit int32 infinity flag (1 = identity).  Carrying the
+flag avoids data-dependent field-equality tests (which need sequential
+carry scans) in the hot paths.
+
+``add_unsafe`` is branch-free and WRONG when both inputs are the same
+non-identity point or exact negatives.  Its callers guarantee that can't
+happen (or happens with cryptographically negligible probability):
+
+* ``scalar_mul``: acc = m·B meets addend B only if m ≡ ±1 (mod r); after
+  the first set bit m ∈ [2, 2^255) and the scalars here are either
+  Fiat-Shamir RLC coefficients (< 2^128 ≪ r) or Lagrange coefficients we
+  derive ourselves — hitting (r±1)/2 prefixes is a 2^-250-class event an
+  adversary cannot steer.
+* tree reduction over RLC-scaled points: points are c_i·P_i with c_i
+  Fiat-Shamir coefficients fixed only after the P_i are committed, so
+  engineered cancellations/collisions are negligible.
+
+``add_safe`` (field-equality corrected, sequential scans inside) exists
+for tests and cold paths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hbbft_tpu.crypto.bls import fields as F
+from hbbft_tpu.crypto.tpu import fq, fq2
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class Ops:
+    """Field-op namespace a curve works over (G1: fq, G2: fq2)."""
+
+    add: Callable
+    sub: Callable
+    mul: Callable
+    sqr: Callable
+    small_mul: Callable
+    is_zero: Callable
+    one: np.ndarray
+    zero: np.ndarray
+    elem_ndim: int  # trailing dims of one field element
+
+
+G1_OPS = Ops(fq.add, fq.sub, fq.mont_mul, fq.mont_sqr, fq.small_mul,
+             fq.is_zero, fq.ONE_MONT, fq.ZERO, 1)
+G2_OPS = Ops(fq2.add, fq2.sub, fq2.mul, fq2.sqr, fq2.small_mul,
+             fq2.is_zero, fq2.ONE, fq2.ZERO, 2)
+
+
+def identity(ops: Ops, batch: Tuple[int, ...] = ()) -> Point:
+    one = jnp.broadcast_to(jnp.asarray(ops.one), (*batch, *ops.one.shape))
+    zero = jnp.broadcast_to(jnp.asarray(ops.zero), (*batch, *ops.zero.shape))
+    return (one, one, zero, jnp.ones(batch, dtype=jnp.int32))
+
+
+def double(ops: Ops, p: Point) -> Point:
+    """Jacobian doubling (a = 0 curve).  Correct for all inputs: the
+    subgroup has prime order, so y = 0 never occurs on valid points, and
+    the identity flag rides through unchanged (z' = 2yz keeps z = 0)."""
+    x, y, z, inf = p
+    a = ops.sqr(x)
+    b = ops.sqr(y)
+    c = ops.sqr(b)
+    d = ops.small_mul(ops.sub(ops.sub(ops.sqr(ops.add(x, b)), a), c), 2)
+    e = ops.small_mul(a, 3)
+    f = ops.sqr(e)
+    x3 = ops.sub(f, ops.small_mul(d, 2))
+    y3 = ops.sub(ops.mul(e, ops.sub(d, x3)), ops.small_mul(c, 8))
+    z3 = ops.small_mul(ops.mul(y, z), 2)
+    return (x3, y3, z3, inf)
+
+
+def _sel(flag: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """where(flag, a, b) with flag broadcast over trailing element dims."""
+    f = flag.reshape(flag.shape + (1,) * ndim).astype(bool)
+    return jnp.where(f, a, b)
+
+
+def select(flag: jnp.ndarray, p: Point, q: Point, ops: Ops) -> Point:
+    """Pointwise where(flag, p, q)."""
+    return (
+        _sel(flag, p[0], q[0], ops.elem_ndim),
+        _sel(flag, p[1], q[1], ops.elem_ndim),
+        _sel(flag, p[2], q[2], ops.elem_ndim),
+        jnp.where(flag.astype(bool), p[3], q[3]),
+    )
+
+
+def add_unsafe(ops: Ops, p: Point, q: Point) -> Point:
+    """General Jacobian addition; identity flags handled, p == ±q NOT
+    (see module docstring for why callers may rely on that)."""
+    x1, y1, z1, inf1 = p
+    x2, y2, z2, inf2 = q
+    z1z1 = ops.sqr(z1)
+    z2z2 = ops.sqr(z2)
+    u1 = ops.mul(x1, z2z2)
+    u2 = ops.mul(x2, z1z1)
+    s1 = ops.mul(y1, ops.mul(z2, z2z2))
+    s2 = ops.mul(y2, ops.mul(z1, z1z1))
+    h = ops.sub(u2, u1)
+    i = ops.sqr(ops.small_mul(h, 2))
+    j = ops.mul(h, i)
+    rr = ops.small_mul(ops.sub(s2, s1), 2)
+    v = ops.mul(u1, i)
+    x3 = ops.sub(ops.sub(ops.sqr(rr), j), ops.small_mul(v, 2))
+    y3 = ops.sub(ops.mul(rr, ops.sub(v, x3)), ops.small_mul(ops.mul(s1, j), 2))
+    z3 = ops.mul(ops.small_mul(ops.mul(z1, z2), 2), h)
+    out: Point = (x3, y3, z3, jnp.zeros_like(inf1))
+    out = select(inf1, q, out, ops)
+    out = select(inf2 & (1 - inf1), p, out, ops)
+    return out
+
+
+def add_safe(ops: Ops, p: Point, q: Point) -> Point:
+    """Addition correct for ALL inputs (uses field-equality tests; slow —
+    sequential scans — so keep out of scans/hot loops)."""
+    x1, y1, z1, inf1 = p
+    x2, y2, z2, inf2 = q
+    z1z1 = ops.sqr(z1)
+    z2z2 = ops.sqr(z2)
+    u1 = ops.mul(x1, z2z2)
+    u2 = ops.mul(x2, z1z1)
+    s1 = ops.mul(y1, ops.mul(z2, z2z2))
+    s2 = ops.mul(y2, ops.mul(z1, z1z1))
+    h_zero = ops.is_zero(ops.sub(u2, u1)).astype(jnp.int32)
+    r_zero = ops.is_zero(ops.sub(s2, s1)).astype(jnp.int32)
+    both = (1 - inf1) * (1 - inf2)
+    is_dbl = both * h_zero * r_zero
+    is_cancel = both * h_zero * (1 - r_zero)
+    out = add_unsafe(ops, p, q)
+    out = select(is_dbl, double(ops, p), out, ops)
+    out = select(is_cancel, identity(ops, tuple(inf1.shape)), out, ops)
+    return out
+
+
+def neg(ops: Ops, p: Point) -> Point:
+    x, y, z, inf = p
+    return (x, ops.sub(jnp.zeros_like(y), y), z, inf)
+
+
+def scalar_mul(ops: Ops, base: Point, bits: jnp.ndarray) -> Point:
+    """Batched double-and-add: bits ``(..., nbits)`` int32, MSB first.
+
+    Scans over the bit axis; everything else is batch.  See module
+    docstring for the add_unsafe safety argument.
+    """
+    nbits = bits.shape[-1]
+    batch = bits.shape[:-1]
+    acc = identity(ops, batch)
+    started = jnp.zeros(batch, dtype=jnp.int32)
+    xs = jnp.moveaxis(bits, -1, 0)  # (nbits, ...)
+
+    def step(carry, bit):
+        acc, started = carry
+        acc = double(ops, acc)
+        # "acc is identity" is exactly "no set bit yet": use the flag
+        # instead of a field test.
+        acc_id = (1 - started)
+        summed = add_unsafe(ops, (acc[0], acc[1], acc[2], acc_id), base)
+        acc = select(bit, summed, acc, ops)
+        started = started | bit
+        return (acc, started), None
+
+    (acc, started), _ = jax.lax.scan(step, (acc, started), xs)
+    x, y, z, _ = acc
+    inf = (1 - started) | base[3]
+    return (x, y, z, inf)
+
+
+def tree_sum(ops: Ops, pts: Point) -> Point:
+    """Sum a batch of points over axis 0 (log2 rounds of add_unsafe)."""
+    n = pts[0].shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        top = _slice_or_identity(pts, half, n, ops)
+        bottom = tuple(x[:half] for x in pts)
+        pts = add_unsafe(ops, bottom, top)
+        n = half
+    return tuple(x[0] for x in pts)
+
+
+def _slice_or_identity(pts: Point, half: int, n: int, ops: Ops) -> Point:
+    """pts[half:n] padded with identities up to length half."""
+    idx = jnp.arange(half)
+    valid = idx + half < n
+    gather = jnp.clip(idx + half, 0, n - 1)
+    sliced = tuple(x[gather] for x in pts)
+    return select(valid, sliced, identity(ops, (half,)), ops)
+
+
+# ---------------------------------------------------------------------------
+# Host conversions to/from the oracle's Jacobian-int representation
+# ---------------------------------------------------------------------------
+
+
+def g1_to_dev(jacs) -> Point:
+    """Host: list of oracle G1 Jacobian points -> batched device point."""
+    xs, ys, zs, infs = [], [], [], []
+    for p in jacs:
+        x, y, z = p
+        is_inf = z % F.P == 0
+        infs.append(1 if is_inf else 0)
+        xs.append(fq.to_mont_np(1 if is_inf else x))
+        ys.append(fq.to_mont_np(1 if is_inf else y))
+        zs.append(fq.to_mont_np(0 if is_inf else z))
+    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
+            jnp.asarray(np.stack(zs)), jnp.asarray(np.array(infs, dtype=np.int32)))
+
+
+def g2_to_dev(jacs) -> Point:
+    xs, ys, zs, infs = [], [], [], []
+    for p in jacs:
+        x, y, z = p
+        is_inf = z[0] % F.P == 0 and z[1] % F.P == 0
+        infs.append(1 if is_inf else 0)
+        xs.append(fq2.to_mont_np((1, 0) if is_inf else x))
+        ys.append(fq2.to_mont_np((1, 0) if is_inf else y))
+        zs.append(fq2.to_mont_np((0, 0) if is_inf else z))
+    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
+            jnp.asarray(np.stack(zs)), jnp.asarray(np.array(infs, dtype=np.int32)))
+
+
+def g1_from_dev(p: Point, idx=None):
+    """Host: one device G1 point -> oracle Jacobian int tuple."""
+    x, y, z, inf = [np.asarray(v) for v in p]
+    if idx is not None:
+        x, y, z, inf = x[idx], y[idx], z[idx], inf[idx]
+    if int(inf):
+        return (1, 1, 0)
+    return (fq.from_mont_int(x), fq.from_mont_int(y), fq.from_mont_int(z))
+
+
+def g2_from_dev(p: Point, idx=None):
+    x, y, z, inf = [np.asarray(v) for v in p]
+    if idx is not None:
+        x, y, z, inf = x[idx], y[idx], z[idx], inf[idx]
+    if int(inf):
+        return ((1, 0), (1, 0), (0, 0))
+    return (fq2.from_mont_int(x), fq2.from_mont_int(y), fq2.from_mont_int(z))
+
+
+def scalars_to_bits(scalars, nbits: int) -> jnp.ndarray:
+    """Host: list of ints -> (N, nbits) int32 MSB-first bit matrix."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        assert 0 <= s < (1 << nbits)
+        for j in range(nbits):
+            out[i, nbits - 1 - j] = (s >> j) & 1
+    return jnp.asarray(out)

@@ -1,0 +1,258 @@
+"""Batched BLS12-381 base-field arithmetic for TPU (JAX, int32 limbs).
+
+This is the device half of the crypto plane (SURVEY.md §7): the hot
+pairing-check algebra — replacing upstream ``threshold_crypto``'s pure-Rust
+``pairing`` backend (SURVEY.md §2 #14) — expressed as vectorized int32
+limb arithmetic that XLA can tile over a TPU's VPU/MXU.
+
+Representation
+--------------
+An Fq element is ``(..., NL)`` int32 limbs, little-endian, radix
+``2^B = 2^11``, ``NL = 36`` limbs (396 bits), ALL LIMBS NONNEGATIVE in
+``[0, 4096]``; values are in Montgomery form (``x·R mod P``, R = 2^396)
+and only canonicalized on host at the boundary.
+
+Design rules (each independently forced by TPU constraints):
+
+* **11-bit limbs**: products (< 2^24) and 36-term convolution sums
+  (< 2^29.2) fit int32 lanes — TPUs have no 64-bit integer path.
+* **Nonnegative limbs**: with limbs >= 0, a bound on the VALUE bounds
+  every limb's contribution, so dropping provably-zero high limbs after
+  a carry is sound.  (Signed/borrow representations admit "ghost" ±1
+  top limbs compensated by lower limbs of the other sign — those made
+  bounded-round carry propagation unsound; this was learned the hard
+  way.)  Subtraction therefore goes through a limb-wise complement:
+  ``a - b ≡ a + (CVEC - b) + DELTA  (mod P)`` where CVEC has every limb
+  4095 (so the limb subtraction never borrows) and DELTA ≡ -CVEC (mod P).
+* **R = 2^396 ≫ P (15 spare bits)**: Montgomery SOS reduction lands far
+  below 2^396, so redundant limbs never need an exact (sequential,
+  rippling) normalization on device.
+* **Value folding**: ops that grow values re-fold bits above 2^385
+  through ``2^385 mod P`` (≈ 0.7P, shrinking ≈5 bits per stage); the
+  number of stages is chosen statically per op from its worst-case bound.
+
+Invariant (every public op requires and guarantees):
+    limbs in [0, 4096],  value in [0, 2^385.9).
+``mont_mul`` tolerates values < 2^386 and returns < 2^382.5.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hbbft_tpu.crypto.bls.fields import P
+
+B = 11
+NL = 36
+MASK = (1 << B) - 1
+R_BITS = B * NL  # 396
+R = 1 << R_BITS
+R2 = (R * R) % P
+NPRIME = (-pow(P, -1, R)) % R  # P * NPRIME ≡ -1 (mod R)
+FOLD_AT = B * (NL - 1)  # 385: the value-fold boundary (limb 35's weight)
+
+I32 = jnp.int32
+
+
+def to_limbs_np(x: int, n: int = NL) -> np.ndarray:
+    """Host: nonnegative int -> strict little-endian limbs."""
+    assert 0 <= x < (1 << (B * n)), "value does not fit"
+    out = np.empty(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & MASK
+        x >>= B
+    return out
+
+
+def from_limbs_int(a) -> int:
+    """Host: limbs -> Python int value."""
+    arr = np.asarray(a).astype(object).reshape(-1)
+    acc = 0
+    for i, v in enumerate(arr):
+        acc += int(v) << (B * i)
+    return acc
+
+
+# Precomputed constants (strict limbs).
+P_LIMBS = to_limbs_np(P)
+NPRIME_LIMBS = to_limbs_np(NPRIME)
+ONE_MONT = to_limbs_np(R % P)  # Montgomery form of 1
+FOLD385 = to_limbs_np((1 << FOLD_AT) % P, n=NL - 1)
+ZERO = np.zeros(NL, dtype=np.int32)
+# Subtraction complement: CVEC has every limb 2^15-1 (>= any loose limb,
+# and >= the raw 6-term coefficient sums the Fq12 layer feeds in);
+# DELTA ≡ -value(CVEC) (mod P); both strict-limb constants.
+CVEC = np.full(NL, 32767, dtype=np.int32)
+_CVEC_VAL = from_limbs_int(CVEC)
+DELTA = to_limbs_np((-_CVEC_VAL) % P)
+
+
+def to_mont_np(x: int) -> np.ndarray:
+    """Host: canonical int mod P -> Montgomery-form strict limbs."""
+    return to_limbs_np((x % P) * R % P)
+
+
+def from_mont_int(a) -> int:
+    """Host: Montgomery limbs -> canonical int mod P."""
+    return (from_limbs_int(a) * pow(R, -1, P)) % P
+
+
+def _carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Redistribute nonneg limbs down to [0, 4096]; value preserved.
+
+    Pads rounds+1 limbs so the top limb never receives a carry (carries
+    travel one limb per round) — value conservation is structural, and
+    all quantities stay nonnegative (input limbs must be >= 0).
+    """
+    x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, rounds + 1)])
+    for _ in range(rounds):
+        lo = x & MASK
+        c = x >> B
+        x = lo + jnp.pad(c[..., :-1], [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    return x
+
+
+def _fold(x: jnp.ndarray, stages: int) -> jnp.ndarray:
+    """Fold value bits above 2^385 back in via 2^385 mod P, ``stages``
+    times, then truncate to NL limbs.  Requires nonneg limbs (post-carry)
+    and value < 2^398; each stage shrinks the excess ~5 bits, and the
+    final truncation is provably lossless for value < 2^396."""
+    for _ in range(stages):
+        e = x[..., NL - 1]
+        for i in range(NL, min(x.shape[-1], NL + 2)):
+            e = e + x[..., i] * (1 << (B * (i - (NL - 1))))
+        folded = x[..., : NL - 1] + e[..., None] * jnp.asarray(FOLD385)
+        x = _carry(folded, rounds=2)
+    return x[..., :NL]
+
+
+# Anti-diagonal scatter: SCATTER[i*NL+j, k] = 1 iff i+j == k.  Turns the
+# limb convolution into outer-product + one matmul — a handful of XLA ops
+# (vs 36 unrolled slice-updates), which keeps the big pairing graphs
+# compilable and feeds the TPU a dot instead of scalar loops.
+_SCATTER = np.zeros((NL * NL, 2 * NL - 1), dtype=np.int32)
+for _i in range(NL):
+    for _j in range(NL):
+        _SCATTER[_i * NL + _j, _i + _j] = 1
+
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limb convolution: (..., NL) x (..., NL) -> (..., 2*NL-1)."""
+    outer = a[..., :, None] * b[..., None, :]
+    batch = outer.shape[:-2]
+    return jnp.matmul(
+        outer.reshape(*batch, NL * NL), jnp.asarray(_SCATTER)
+    )
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R^-1 (mod P), batched.
+
+    SOS with redundant nonneg limbs: T = a·b; m ≡ T·N' (mod R);
+    t = (T + m·P)/R.  The division is exact in value; the carried low
+    part's value is exactly corr·R with corr in {0, 1, 2} (redundant m),
+    read off limb 35.  Inputs: value < 2^386.  Output: value < 2^382.5.
+    """
+    t = _carry(_conv(a, b), rounds=3)
+    m = _carry(_conv(t[..., :NL], jnp.asarray(NPRIME_LIMBS)), rounds=3)[..., :NL]
+    mp = _conv(m, jnp.asarray(P_LIMBS))
+    full = jnp.pad(
+        t, [(0, 0)] * (t.ndim - 1) + [(0, max(0, mp.shape[-1] - t.shape[-1]))]
+    )
+    full = full.at[..., : mp.shape[-1]].add(mp)
+    full = _carry(full, rounds=3)
+    lo, hi = full[..., :NL], full[..., NL : 2 * NL]
+    # value(lo) = corr·R exactly; limb 35 sits in [2048·corr - 3, 2048·corr].
+    corr = (lo[..., NL - 1] + 3) >> B
+    return _carry(hi.at[..., 0].add(corr), rounds=1)[..., :NL]
+
+
+def mont_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mont_mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _fold(_carry(a + b, rounds=2), stages=1)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (mod P) via the borrow-free complement (module docstring).
+    Accepts limbs up to 2^15-1 (raw coefficient sums), value < 2^389."""
+    d = a + (jnp.asarray(CVEC) - b) + jnp.asarray(DELTA)
+    return _fold(_carry(d, rounds=2), stages=3)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    d = (jnp.asarray(CVEC) - a) + jnp.asarray(DELTA)
+    return _fold(_carry(d, rounds=2), stages=3)
+
+
+def small_mul(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small positive constant (k <= 16)."""
+    assert 0 < k <= 16
+    return _fold(_carry(a * k, rounds=2), stages=1)
+
+
+def normalize(a: jnp.ndarray) -> jnp.ndarray:
+    """Re-settle into the invariant range; value unchanged mod P."""
+    return _fold(_carry(a, rounds=2), stages=1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """Value ≡ 0 (mod P)?  Batched, device-side (sequential scans; keep
+    out of hot loops — flag-carrying point code avoids needing this).
+
+    One Montgomery shrink pass maps a (value < 2^386) to a value
+    ≡ a (mod P) in [0, 2.1P); that is ≡ 0 mod P iff it equals 0, P, or
+    2P — test each exactly.
+    """
+    v = mont_mul(a, jnp.asarray(ONE_MONT))
+    acc = _is_exact_zero(v)
+    for k in (1, 2):
+        acc = acc | _is_exact_zero(v - jnp.asarray(to_limbs_np(k * P)))
+    return acc
+
+
+def _is_exact_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact value==0 test via sequential carry scan (NL tiny steps).
+    Input limbs may be signed here (difference of nonneg vectors)."""
+
+    def step(c, limb):
+        s = limb + c
+        return s >> B, s & MASK
+
+    carry0 = jnp.zeros(x.shape[:-1], dtype=I32)
+    xs = jnp.moveaxis(x, -1, 0)
+    final_c, lows = jax.lax.scan(step, carry0, xs)
+    return (final_c == 0) & jnp.all(lows == 0, axis=0)
+
+
+def pow_fixed(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e (Montgomery), e a fixed Python int — square-and-multiply scan."""
+    bits = jnp.asarray([(e >> i) & 1 for i in range(e.bit_length())], dtype=I32)
+    acc = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+
+    def step(carry, bit):
+        acc, base = carry
+        acc = jnp.where(bit != 0, mont_mul(acc, base), acc)
+        return (acc, mont_sqr(base)), None
+
+    (acc, _), _ = jax.lax.scan(step, (acc, a), bits)
+    return acc
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Field inverse by Fermat: a^(P-2). ~570 muls — use sparingly."""
+    return pow_fixed(a, P - 2)
+
+
+def rand_elems(rng: np.random.Generator, shape=()) -> jnp.ndarray:
+    """Host helper: random canonical Montgomery elements for tests."""
+    flat = int(np.prod(shape)) if shape else 1
+    outs = [
+        to_mont_np(int.from_bytes(rng.bytes(48), "big") % P) for _ in range(flat)
+    ]
+    arr = np.stack(outs).reshape(*shape, NL) if shape else outs[0]
+    return jnp.asarray(arr)

@@ -1,0 +1,88 @@
+"""Batched Fq2 = Fq[u]/(u^2+1) arithmetic on TPU limbs.
+
+Elements are ``(..., 2, NL)`` int32 limb arrays (c0 + c1·u), components in
+Montgomery form.  Componentwise ops lift directly from :mod:`fq` (they act
+on the last axis); mul/sqr use Karatsuba (3 base muls).
+
+Mirrors the oracle tower in ``hbbft_tpu/crypto/bls/fields.py``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from hbbft_tpu.crypto.tpu import fq
+
+NL = fq.NL
+
+ZERO = np.zeros((2, NL), dtype=np.int32)
+ONE = np.stack([fq.ONE_MONT, fq.ZERO])
+
+
+def to_mont_np(c: tuple) -> np.ndarray:
+    """Host: oracle (c0, c1) int tuple -> (2, NL) Montgomery limbs."""
+    return np.stack([fq.to_mont_np(c[0]), fq.to_mont_np(c[1])])
+
+
+def from_mont_int(a) -> tuple:
+    arr = np.asarray(a)
+    return (fq.from_mont_int(arr[..., 0, :]), fq.from_mont_int(arr[..., 1, :]))
+
+
+add = fq.add
+sub = fq.sub
+neg = fq.neg
+small_mul = fq.small_mul
+normalize = fq.normalize
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    t0 = fq.mont_mul(a0, b0)
+    t1 = fq.mont_mul(a1, b1)
+    t2 = fq.mont_mul(fq.add(a0, a1), fq.add(b0, b1))
+    c0 = fq.sub(t0, t1)
+    c1 = fq.sub(fq.sub(t2, t0), t1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    t = fq.mont_mul(a0, a1)
+    c0 = fq.mont_mul(fq.add(a0, a1), fq.sub(a0, a1))
+    c1 = fq.add(t, t)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def mul_fq(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by a base-field scalar s: (..., NL)."""
+    return jnp.stack(
+        [fq.mont_mul(a[..., 0, :], s), fq.mont_mul(a[..., 1, :], s)], axis=-2
+    )
+
+
+def conj(a: jnp.ndarray) -> jnp.ndarray:
+    """Frobenius on Fq2: c0 - c1·u."""
+    return jnp.stack([a[..., 0, :], fq.neg(a[..., 1, :])], axis=-2)
+
+
+def mul_by_xi(a: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by the sextic non-residue xi = 1 + u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([fq.sub(a0, a1), fq.add(a0, a1)], axis=-2)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return fq.is_zero(a[..., 0, :]) & fq.is_zero(a[..., 1, :])
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """(a0 + a1·u)^-1 = (a0 - a1·u) / (a0^2 + a1^2).  One Fq inversion."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = fq.add(fq.mont_sqr(a0), fq.mont_sqr(a1))
+    ninv = fq.inv(norm)
+    return jnp.stack(
+        [fq.mont_mul(a0, ninv), fq.neg(fq.mont_mul(a1, ninv))], axis=-2
+    )

@@ -153,6 +153,28 @@ class VirtualNet:
             if f.node_id in correct
         ]
 
+    # -- membership (upstream net_dynamic_hb analog) -------------------
+    def add_node(self, node_id: Any, factory: Callable[[Any, random.Random], ConsensusProtocol]) -> VirtualNode:
+        """Add a node mid-run (e.g. constructed from a ``JoinPlan``).
+
+        ``factory(sink, rng) -> protocol``.  The node starts receiving
+        broadcast traffic from the next send on.
+        """
+        assert node_id not in self.nodes and node_id not in self.faulty_ids
+        node_rng = random.Random(self.rng.getrandbits(64))
+        pool = VerifyPool()
+        proto = factory(pool, node_rng)
+        node = VirtualNode(
+            id=node_id,
+            netinfo=getattr(proto, "netinfo", None),
+            protocol=proto,
+            pool=pool,
+            rng=node_rng,
+        )
+        self.nodes[node_id] = node
+        self.node_order = sorted(self.nodes) + sorted(self.faulty_ids)
+        return node
+
     # -- driving -------------------------------------------------------
     def send_input(self, node_id: Any, input: Any) -> None:
         node = self.nodes[node_id]
@@ -250,6 +272,7 @@ class NetBuilder:
         self.num_nodes = num_nodes
         self.seed = seed
         self._num_faulty: Optional[int] = None
+        self._num_observers = 0
         self._suite: Suite = ScalarSuite()
         self._backend_factory: Callable[[Suite], CryptoBackend] = BatchedBackend
         self._adversary: Adversary = NullAdversary()
@@ -288,15 +311,26 @@ class NetBuilder:
         self._protocol_factory = factory
         return self
 
+    def observers(self, k: int) -> "NetBuilder":
+        """The last ``k`` node ids join as observers: they hold regular
+        keypairs and receive all traffic but are not validators (no
+        threshold key share).  Mirrors upstream NetBuilder observer
+        support; the dynamic-HB churn tests promote them via votes."""
+        self._num_observers = k
+        return self
+
     def build(self) -> VirtualNet:
         assert self._protocol_factory is not None, "protocol factory required"
         rng = random.Random(self.seed)
         n = self.num_nodes
-        f = self._num_faulty if self._num_faulty is not None else (n - 1) // 3
-        assert 3 * f < n, f"need 3f < N (got N={n}, f={f})"
+        n_obs = self._num_observers
+        n_val = n - n_obs
+        f = self._num_faulty if self._num_faulty is not None else (n_val - 1) // 3
+        assert 3 * f < n_val, f"need 3f < N (got N={n_val}, f={f})"
         ids = list(range(n))
-        faulty_ids = ids[n - f :] if f else []
-        correct_ids = ids[: n - f]
+        val_ids = ids[:n_val]
+        faulty_ids = val_ids[n_val - f :] if f else []
+        correct_ids = [i for i in ids if i not in faulty_ids]
 
         suite = self._suite
         sks = SecretKeySet.random(f, rng, suite)
@@ -306,12 +340,13 @@ class NetBuilder:
 
         nodes: Dict[Any, VirtualNode] = {}
         for i in correct_ids:
+            is_val = i in val_ids
             netinfo = NetworkInfo(
                 our_id=i,
-                val_ids=ids,
+                val_ids=val_ids,
                 public_key_set=pks,
-                secret_key_share=sks.secret_key_share(i),
-                public_keys=node_pks,
+                secret_key_share=sks.secret_key_share(val_ids.index(i)) if is_val else None,
+                public_keys={j: node_pks[j] for j in val_ids},
                 secret_key=node_sks[i],
             )
             pool = VerifyPool()

@@ -1,0 +1,6 @@
+"""Device-side (JAX) kernels for the data-plane hot ops.
+
+Counterparts of the host implementations in :mod:`hbbft_tpu.ops`:
+Reed-Solomon erasure coding as GF(2) bit-matmuls (the MXU sees a plain
+integer matmul) and batched Keccak-f[1600]/SHA3-256 for Merkle hashing.
+"""

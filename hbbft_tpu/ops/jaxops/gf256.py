@@ -1,0 +1,105 @@
+"""Reed-Solomon over GF(256) as GF(2) bit-matrix multiplication on TPU.
+
+Reference behavior: the ``reed-solomon-erasure`` crate used by upstream
+``src/broadcast/broadcast.rs`` (SURVEY.md §2 #4), re-expressed for the
+MXU: multiplication by a FIXED GF(256) element is GF(2)-linear on the 8
+bits of a byte, so the whole systematic encode (parity = M ⊗ data over
+GF(256)) becomes
+
+    parity_bits = (ENC_BITS @ data_bits) mod 2
+
+— one integer matmul over {0,1} matrices (batched over shard columns),
+which is exactly the shape a TPU wants.  Reconstruction inverts the
+surviving rows' submatrix on the host (tiny, O(k^3) bytes) and applies
+the same bit-matmul for the bulk data.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Dict, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from hbbft_tpu.ops import gf256 as host
+
+
+def _mul_matrix_gf2(c: int) -> np.ndarray:
+    """The 8x8 GF(2) matrix of y -> c·y in GF(256).
+
+    Column j is the bit pattern of c·x^j (x = 0x02 basis powers).
+    """
+    m = np.zeros((8, 8), dtype=np.int32)
+    for j in range(8):
+        prod = host.gf_mul(c, 1 << j)
+        for i in range(8):
+            m[i, j] = (prod >> i) & 1
+    return m
+
+
+def _expand_bits(mat: np.ndarray) -> np.ndarray:
+    """GF(256) matrix (r, c) -> GF(2) bit matrix (8r, 8c)."""
+    r, c = mat.shape
+    out = np.zeros((8 * r, 8 * c), dtype=np.int32)
+    for i in range(r):
+        for j in range(c):
+            if mat[i, j]:
+                out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = _mul_matrix_gf2(
+                    int(mat[i, j])
+                )
+    return out
+
+
+@lru_cache(maxsize=64)
+def _enc_bits(k: int, n: int) -> np.ndarray:
+    """Bit-expanded parity rows of the systematic encoding matrix."""
+    return _expand_bits(host.encoding_matrix(k, n)[k:])
+
+
+def bytes_to_bits(data: np.ndarray) -> jnp.ndarray:
+    """(r, s) uint8 -> (8r, s) int32 bits (LSB-first per byte)."""
+    bits = np.unpackbits(data[:, None, :], axis=1, bitorder="little")
+    return jnp.asarray(bits.reshape(data.shape[0] * 8, data.shape[1]).astype(np.int32))
+
+
+def bits_to_bytes(bits: np.ndarray) -> np.ndarray:
+    arr = np.asarray(bits, dtype=np.uint8).reshape(-1, 8, bits.shape[-1])
+    return np.packbits(arr, axis=1, bitorder="little").reshape(
+        arr.shape[0], bits.shape[-1]
+    )
+
+
+class ReedSolomonJax:
+    """Systematic RS(k-of-n) with device-side encode/reconstruct."""
+
+    def __init__(self, k: int, n: int) -> None:
+        assert 0 < k <= n <= 255
+        self.k = k
+        self.n = n
+        self._host = host.ReedSolomon(k, n)
+
+    def encode(self, data_shards: Sequence[bytes]) -> List[bytes]:
+        assert len(data_shards) == self.k
+        size = len(data_shards[0])
+        data = np.frombuffer(b"".join(data_shards), dtype=np.uint8).reshape(
+            self.k, size
+        )
+        enc = jnp.asarray(_enc_bits(self.k, self.n))
+        parity_bits = (enc @ bytes_to_bits(data)) & 1
+        parity = bits_to_bytes(np.asarray(parity_bits))
+        return [bytes(r) for r in data] + [bytes(r) for r in parity]
+
+    def reconstruct(self, shards: Dict[int, bytes]) -> List[bytes]:
+        if len(shards) < self.k:
+            raise ValueError(f"need {self.k} shards, got {len(shards)}")
+        idxs = sorted(shards)[: self.k]
+        sub = self._host.matrix[idxs]
+        dec = host.gf_mat_inv(sub)  # host: tiny k x k inverse
+        dec_bits = jnp.asarray(_expand_bits(dec))
+        size = len(shards[idxs[0]])
+        have = np.frombuffer(
+            b"".join(shards[i] for i in idxs), dtype=np.uint8
+        ).reshape(self.k, size)
+        data_bits = (dec_bits @ bytes_to_bits(have)) & 1
+        return [bytes(r) for r in bits_to_bytes(np.asarray(data_bits))]

@@ -1,0 +1,155 @@
+"""Batched Keccak-f[1600] / SHA3-256 in JAX (uint32 lane pairs).
+
+Reference behavior: ``tiny-keccak`` SHA3-256 as used by upstream
+``src/broadcast/merkle.rs`` (SURVEY.md §2 #4).  TPUs have no 64-bit
+integer path, so each 64-bit lane is an (lo, hi) uint32 pair; rotations
+split across the pair.  Everything is elementwise over a leading batch
+axis — hashing a Merkle level of 10k shards is one vectorized call.
+
+Single-block only (message <= 135 bytes after padding): Merkle leaf and
+branch inputs are 1 + 32·2 = 65 bytes, well inside one SHA3-256 block.
+The host path (hashlib) remains the general-length implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+RATE = 136  # SHA3-256 rate in bytes
+
+_ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# rho rotation offsets, indexed [x][y] with lane index x + 5y.
+_RHO = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+U32 = jnp.uint32
+
+
+def _rotl(lo: jnp.ndarray, hi: jnp.ndarray, r: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rotate the 64-bit (lo, hi) pair left by r."""
+    r %= 64
+    if r == 0:
+        return lo, hi
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        nlo = (lo << r) | (hi >> (32 - r))
+        nhi = (hi << r) | (lo >> (32 - r))
+        return nlo, nhi
+    r -= 32
+    nlo = (hi << r) | (lo >> (32 - r))
+    nhi = (lo << r) | (hi >> (32 - r))
+    return nlo, nhi
+
+
+def keccak_f(state: jnp.ndarray) -> jnp.ndarray:
+    """One permutation over ``(..., 25, 2)`` uint32 states (lo, hi)."""
+    lanes_lo = [state[..., i, 0] for i in range(25)]
+    lanes_hi = [state[..., i, 1] for i in range(25)]
+
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c_lo = [lanes_lo[x] ^ lanes_lo[x + 5] ^ lanes_lo[x + 10] ^ lanes_lo[x + 15] ^ lanes_lo[x + 20] for x in range(5)]
+        c_hi = [lanes_hi[x] ^ lanes_hi[x + 5] ^ lanes_hi[x + 10] ^ lanes_hi[x + 15] ^ lanes_hi[x + 20] for x in range(5)]
+        for x in range(5):
+            r_lo, r_hi = _rotl(c_lo[(x + 1) % 5], c_hi[(x + 1) % 5], 1)
+            d_lo = c_lo[(x + 4) % 5] ^ r_lo
+            d_hi = c_hi[(x + 4) % 5] ^ r_hi
+            for y in range(5):
+                lanes_lo[x + 5 * y] = lanes_lo[x + 5 * y] ^ d_lo
+                lanes_hi[x + 5 * y] = lanes_hi[x + 5 * y] ^ d_hi
+        # rho + pi
+        b_lo = [None] * 25
+        b_hi = [None] * 25
+        for x in range(5):
+            for y in range(5):
+                nx, ny = y, (2 * x + 3 * y) % 5
+                r_lo, r_hi = _rotl(lanes_lo[x + 5 * y], lanes_hi[x + 5 * y], _RHO[x][y])
+                b_lo[nx + 5 * ny] = r_lo
+                b_hi[nx + 5 * ny] = r_hi
+        # chi
+        for y in range(5):
+            row_lo = [b_lo[x + 5 * y] for x in range(5)]
+            row_hi = [b_hi[x + 5 * y] for x in range(5)]
+            for x in range(5):
+                lanes_lo[x + 5 * y] = row_lo[x] ^ (~row_lo[(x + 1) % 5] & row_lo[(x + 2) % 5])
+                lanes_hi[x + 5 * y] = row_hi[x] ^ (~row_hi[(x + 1) % 5] & row_hi[(x + 2) % 5])
+        # iota
+        lanes_lo[0] = lanes_lo[0] ^ jnp.uint32(rc & 0xFFFFFFFF)
+        lanes_hi[0] = lanes_hi[0] ^ jnp.uint32(rc >> 32)
+
+    return jnp.stack(
+        [jnp.stack([lanes_lo[i], lanes_hi[i]], axis=-1) for i in range(25)], axis=-2
+    )
+
+
+def pad_block(msgs: np.ndarray) -> np.ndarray:
+    """(batch, m) uint8 messages (m <= RATE-1) -> (batch, RATE) padded."""
+    batch, m = msgs.shape
+    assert m <= RATE - 1, "single-block SHA3 only"
+    out = np.zeros((batch, RATE), dtype=np.uint8)
+    out[:, :m] = msgs
+    out[:, m] = 0x06
+    out[:, RATE - 1] ^= 0x80
+    return out
+
+
+def sha3_256_block(padded: np.ndarray) -> jnp.ndarray:
+    """(batch, RATE) padded blocks -> (batch, 32) uint8 digests."""
+    batch = padded.shape[0]
+    words = np.zeros((batch, 25, 2), dtype=np.uint32)
+    as_u32 = padded.reshape(batch, RATE // 4, 4)
+    vals = (
+        as_u32[..., 0].astype(np.uint32)
+        | (as_u32[..., 1].astype(np.uint32) << 8)
+        | (as_u32[..., 2].astype(np.uint32) << 16)
+        | (as_u32[..., 3].astype(np.uint32) << 24)
+    )
+    for i in range(RATE // 8):
+        words[:, i, 0] = vals[:, 2 * i]
+        words[:, i, 1] = vals[:, 2 * i + 1]
+    out = keccak_f(jnp.asarray(words))
+    dig = np.asarray(out)[:, :4, :]  # first 4 lanes = 32 bytes
+    flat = np.zeros((batch, 32), dtype=np.uint8)
+    for i in range(4):
+        for half in range(2):
+            v = dig[:, i, half]
+            for b in range(4):
+                flat[:, 8 * i + 4 * half + b] = (v >> (8 * b)) & 0xFF
+    return flat
+
+
+def sha3_256_batch(msgs: np.ndarray) -> np.ndarray:
+    """Batched single-block SHA3-256: (batch, m<=135) uint8 -> (batch, 32)."""
+    return np.asarray(sha3_256_block(pad_block(msgs)))
+
+
+def merkle_level(prefix: int, pairs: np.ndarray) -> np.ndarray:
+    """Hash one Merkle level: (batch, 64) sibling pairs -> (batch, 32).
+
+    ``prefix`` is the domain-separation byte (0x01 for branches, matching
+    hbbft_tpu.ops.merkle._h_branch).
+    """
+    batch = pairs.shape[0]
+    msgs = np.concatenate(
+        [np.full((batch, 1), prefix, dtype=np.uint8), pairs.astype(np.uint8)], axis=1
+    )
+    return sha3_256_batch(msgs)

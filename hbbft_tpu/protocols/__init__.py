@@ -5,4 +5,35 @@ message)`` pairs and inputs and emitting a :class:`~hbbft_tpu.protocols.
 traits.Step`.  No I/O, no threads, no clock — the caller owns the event
 loop and the transport, exactly as in the reference (upstream
 ``src/lib.rs`` module docs).
+
+Stack (upstream README's composition diagram):
+``QueueingHoneyBadger -> DynamicHoneyBadger -> HoneyBadger -> Subset ->
+{Broadcast, BinaryAgreement -> ThresholdSign}`` plus ``ThresholdDecrypt``
+per epoch, ``SyncKeyGen`` for membership change, and ``SenderQueue`` as
+the network-facing outbox wrapper.
 """
+
+from hbbft_tpu.protocols.broadcast import Broadcast  # noqa: F401
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement  # noqa: F401
+from hbbft_tpu.protocols.dynamic_honey_badger import (  # noqa: F401
+    Change,
+    ChangeState,
+    DhbBatch,
+    DynamicHoneyBadger,
+    JoinPlan,
+)
+from hbbft_tpu.protocols.honey_badger import (  # noqa: F401
+    Batch,
+    EncryptionSchedule,
+    HoneyBadger,
+)
+from hbbft_tpu.protocols.queueing_honey_badger import (  # noqa: F401
+    Input,
+    QueueingHoneyBadger,
+)
+from hbbft_tpu.protocols.sender_queue import SenderQueue  # noqa: F401
+from hbbft_tpu.protocols.subset import Subset, SubsetOutput  # noqa: F401
+from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen  # noqa: F401
+from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt  # noqa: F401
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign  # noqa: F401
+from hbbft_tpu.protocols.transaction_queue import TransactionQueue  # noqa: F401

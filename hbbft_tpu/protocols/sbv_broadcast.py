@@ -47,6 +47,8 @@ class SbvBroadcast:
         self._bval_sent: Set[bool] = set()
         self._aux_received: Dict[bool, Set[Any]] = {False: set(), True: set()}
         self._aux_sent = False
+        self._termed_bval: Dict[bool, Set[Any]] = {False: set(), True: set()}
+        self._termed_aux: Dict[bool, Set[Any]] = {False: set(), True: set()}
         self.bin_values = BoolSet.none()
         self._last_output: BoolSet | None = None
 
@@ -57,6 +59,10 @@ class SbvBroadcast:
     def handle_bval(self, sender: Any, b: bool) -> Step:
         step = Step.empty()
         if sender in self._bval_received[b]:
+            if sender in self._termed_bval[b]:
+                # The one real message racing its own Term evidence.
+                self._termed_bval[b].discard(sender)
+                return step
             return step.fault(sender, FAULT_DUPLICATE_BVAL)
         self._bval_received[b].add(sender)
         count = len(self._bval_received[b])
@@ -74,16 +80,27 @@ class SbvBroadcast:
     def handle_aux(self, sender: Any, b: bool) -> Step:
         step = Step.empty()
         if sender in self._aux_received[b]:
+            if sender in self._termed_aux[b]:
+                # The one real message racing its own Term evidence.
+                self._termed_aux[b].discard(sender)
+                return step
             return step.fault(sender, FAULT_DUPLICATE_AUX)
         self._aux_received[b].add(sender)
         return step.extend(self._try_output())
 
     def add_term_evidence(self, sender: Any, b: bool) -> Step:
-        """A Term(b) counts as this sender's BVal(b) and Aux(b) forever."""
+        """A Term(b) counts as this sender's BVal(b) and Aux(b) forever.
+
+        The sender's genuine BVal/Aux may still be in flight (delivered
+        after the Term under reordering); each gets ONE free pass — any
+        further duplicate is flagged as Byzantine as usual.
+        """
         step = Step.empty()
         if sender not in self._bval_received[b]:
+            self._termed_bval[b].add(sender)
             step.extend(self.handle_bval(sender, b))
         if sender not in self._aux_received[b]:
+            self._termed_aux[b].add(sender)
             step.extend(self.handle_aux(sender, b))
         return step
 

@@ -1,0 +1,210 @@
+"""SenderQueue: epoch-aware outbox for real (lossless, ordered) links.
+
+Reference: upstream ``src/sender_queue/{mod,message,honey_badger,
+dynamic_honey_badger,queueing_honey_badger}.rs`` (SURVEY.md §2 #13).
+
+The wrapped protocol's messages are only valid within an (era, epoch)
+window; sending one to a peer that is far behind would make the peer
+flag us as a flooder, and sending to a peer that has moved on wastes
+bandwidth.  ``SenderQueue``:
+
+* broadcasts ``EpochStarted(era, epoch)`` whenever our own protocol
+  advances;
+* tracks every peer's last announced (era, epoch);
+* expands ``Target::All``-style messages into per-peer sends and holds
+  each until the peer's announced window admits it (ahead-of-window
+  messages buffer; behind-of-window messages drop);
+* implements ``ConsensusProtocol`` itself, so the caller's event loop
+  sees one protocol.
+
+Adapters: any wrapped protocol works given an ``epoch_of(message) ->
+(era, epoch)`` and a ``current_epoch(protocol) -> (era, epoch)``; the
+standard ones for HoneyBadger / DynamicHoneyBadger /
+QueueingHoneyBadger are provided (upstream's
+``SenderQueueableConsensusProtocol`` impls).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage, DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import HbMessage, HoneyBadger
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step, Target, TargetedMessage
+
+FAULT_MALFORMED = "sender_queue:malformed-message"
+
+EpochId = Tuple[int, int]  # (era, epoch), lexicographic
+
+
+@dataclass(frozen=True)
+class SqMessage:
+    kind: str  # "epoch_started" | "algo"
+    value: Any
+
+    @staticmethod
+    def epoch_started(epoch: EpochId) -> "SqMessage":
+        return SqMessage("epoch_started", epoch)
+
+    @staticmethod
+    def algo(inner: Any) -> "SqMessage":
+        return SqMessage("algo", inner)
+
+
+def _hb_epoch_of(message: HbMessage) -> EpochId:
+    return (0, message.epoch)
+
+
+def _hb_current(hb: HoneyBadger) -> EpochId:
+    return (0, hb.epoch)
+
+
+def _dhb_epoch_of(message: DhbMessage) -> EpochId:
+    return (message.era, message.inner.epoch)
+
+
+def _dhb_current(dhb: DynamicHoneyBadger) -> EpochId:
+    return (dhb.era, dhb._hb.epoch)
+
+
+def _qhb_current(qhb: QueueingHoneyBadger) -> EpochId:
+    return _dhb_current(qhb.dhb)
+
+
+class SenderQueue(ConsensusProtocol):
+    def __init__(
+        self,
+        inner: ConsensusProtocol,
+        peers: List[Any],
+        epoch_of: Optional[Callable[[Any], EpochId]] = None,
+        current_epoch: Optional[Callable[[Any], EpochId]] = None,
+        max_future_epochs: int = 3,
+    ) -> None:
+        self.inner = inner
+        self.max_future_epochs = max_future_epochs
+        self._epoch_of = epoch_of or _default_epoch_of(inner)
+        self._current = current_epoch or _default_current(inner)
+        self._peers = [p for p in peers if p != inner.our_id]
+        self._peer_epochs: Dict[Any, EpochId] = {p: (0, 0) for p in self._peers}
+        self._outbox: Dict[Any, List[Tuple[EpochId, Any]]] = {p: [] for p in self._peers}
+        self._last_announced: Optional[EpochId] = None
+
+    @classmethod
+    def wrap(
+        cls,
+        inner_factory: Callable[[Any], ConsensusProtocol],
+        sink: Any,
+        peers: List[Any],
+        **kwargs: Any,
+    ) -> "SenderQueue":
+        """Build the inner protocol with a sink scoped through this
+        SenderQueue, so steps surfacing from deferred-verification
+        flushes are epoch-gated and wrapped exactly like ordinary ones.
+
+        ``inner_factory(scoped_sink) -> protocol``.
+        """
+        box: List["SenderQueue"] = []
+        scoped = sink.scoped(lambda step: box[0]._post(step) if box else step)
+        inner = inner_factory(scoped)
+        sq = cls(inner, peers, **kwargs)
+        box.append(sq)
+        return sq
+
+    # -- ConsensusProtocol --------------------------------------------
+    @property
+    def our_id(self) -> Any:
+        return self.inner.our_id
+
+    @property
+    def terminated(self) -> bool:
+        return self.inner.terminated
+
+    def handle_input(self, input: Any, rng: Any) -> Step:
+        return self._post(self.inner.handle_input(input, rng))
+
+    def handle_message(self, sender: Any, message: Any, rng: Any) -> Step:
+        if not isinstance(message, SqMessage):
+            return Step.empty().fault(sender, FAULT_MALFORMED)
+        if message.kind == "epoch_started":
+            return self._on_epoch_started(sender, message.value)
+        if message.kind == "algo":
+            return self._post(self.inner.handle_message(sender, message.value, rng))
+        return Step.empty().fault(sender, FAULT_MALFORMED)
+
+    # -- internals -----------------------------------------------------
+    def _on_epoch_started(self, peer: Any, epoch: Any) -> Step:
+        step = Step.empty()
+        if (
+            not isinstance(epoch, tuple)
+            or len(epoch) != 2
+            or not all(isinstance(x, int) and not isinstance(x, bool) for x in epoch)
+        ):
+            return step.fault(peer, FAULT_MALFORMED)
+        if peer not in self._peer_epochs:
+            self._peer_epochs[peer] = (0, 0)
+            self._outbox[peer] = []
+            self._peers.append(peer)
+        if epoch <= self._peer_epochs[peer]:
+            return step
+        self._peer_epochs[peer] = epoch
+        held, self._outbox[peer] = self._outbox[peer], []
+        for msg_epoch, msg in held:
+            self._route(step, peer, msg_epoch, msg)
+        return step
+
+    def _admits(self, peer_epoch: EpochId, msg_epoch: EpochId) -> str:
+        """'send' | 'hold' | 'drop' for a message vs a peer's window."""
+        if msg_epoch[0] < peer_epoch[0]:
+            return "drop"  # stale era
+        if msg_epoch[0] > peer_epoch[0]:
+            return "hold"  # future era: wait for the peer to get there
+        if msg_epoch[1] < peer_epoch[1]:
+            return "drop"  # stale epoch
+        if msg_epoch[1] > peer_epoch[1] + self.max_future_epochs:
+            return "hold"
+        return "send"
+
+    def _route(self, step: Step, peer: Any, msg_epoch: EpochId, msg: Any) -> None:
+        verdict = self._admits(self._peer_epochs[peer], msg_epoch)
+        if verdict == "send":
+            step.send(peer, SqMessage.algo(msg))
+        elif verdict == "hold":
+            self._outbox[peer].append((msg_epoch, msg))
+
+    def _post(self, inner_step: Step) -> Step:
+        """Expand + gate the inner step's messages; announce our epoch."""
+        step = Step(
+            output=inner_step.output, messages=[], fault_log=inner_step.fault_log
+        )
+        for tm in inner_step.messages:
+            recipients = tm.target.recipients(self._peers, None)
+            msg_epoch = self._epoch_of(tm.message)
+            for peer in recipients:
+                if peer == self.our_id:
+                    continue
+                self._route(step, peer, msg_epoch, tm.message)
+        cur = self._current(self.inner)
+        if cur != self._last_announced:
+            self._last_announced = cur
+            step.broadcast(SqMessage.epoch_started(cur))
+        return step
+
+
+def _default_epoch_of(inner: ConsensusProtocol) -> Callable[[Any], EpochId]:
+    if isinstance(inner, (DynamicHoneyBadger, QueueingHoneyBadger)):
+        return _dhb_epoch_of
+    if isinstance(inner, HoneyBadger):
+        return _hb_epoch_of
+    raise TypeError(f"no SenderQueue adapter for {type(inner)!r}")
+
+
+def _default_current(inner: ConsensusProtocol) -> Callable[[Any], EpochId]:
+    if isinstance(inner, QueueingHoneyBadger):
+        return _qhb_current
+    if isinstance(inner, DynamicHoneyBadger):
+        return _dhb_current
+    if isinstance(inner, HoneyBadger):
+        return _hb_current
+    raise TypeError(f"no SenderQueue adapter for {type(inner)!r}")

@@ -1,0 +1,26 @@
+"""Persistent XLA compilation cache setup.
+
+The crypto kernels are large graphs (batched 381-bit limb arithmetic,
+Miller-loop scans); first compilation is expensive.  Pointing JAX at an
+on-disk cache makes every later process start (tests, bench, driver
+entry checks) reuse the compiled executables.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_cache(path: str | None = None) -> None:
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "HBBFT_TPU_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jaxlib without the knobs — caching is best-effort

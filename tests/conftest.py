@@ -14,3 +14,19 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+# The axon plugin's sitecustomize registers its backend and pins
+# jax_platforms at interpreter start, before this file runs — env vars
+# alone cannot re-select the CPU platform.  Re-select and clear the
+# backend cache (no arrays exist yet, so this is safe).
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+    jax.config.update("jax_platforms", "cpu")
+    from jax.extend.backend import clear_backends  # noqa: E402
+
+    clear_backends()
+
+from hbbft_tpu.utils.jaxcache import enable_cache  # noqa: E402
+
+enable_cache()

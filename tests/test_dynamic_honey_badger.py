@@ -1,0 +1,211 @@
+"""DynamicHoneyBadger churn tests — benchmark config 4 shape.
+
+Reference analogs: upstream ``tests/dynamic_honey_badger.rs`` and
+``tests/net_dynamic_hb.rs``: batches agree across nodes, era changes
+complete (remove + add via votes and the embedded DKG), and the new
+validator set signs/decrypts with the NEW threshold keys.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.keys import SecretKey
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.net import NetBuilder, NullAdversary, ReorderingAdversary
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    Change,
+    ChangeState,
+    DhbBatch,
+    DynamicHoneyBadger,
+    JoinPlan,
+)
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+
+
+def build_dhb_net(n=4, seed=0, adversary=None, observers=0, schedule=None, f=0):
+    schedule = schedule or EncryptionSchedule.always()
+    b = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .protocol(
+            lambda ni, sink, rng: DynamicHoneyBadger(
+                ni, sink, session_id=b"dhb-test", encryption_schedule=schedule
+            )
+        )
+    )
+    if observers:
+        b = b.observers(observers)
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+def batches_of(net, nid):
+    return [o for o in net.node(nid).outputs if isinstance(o, DhbBatch)]
+
+
+def drive_epoch(net, epoch_idx, proposers=None):
+    proposers = proposers if proposers is not None else net.correct_ids
+    for nid in proposers:
+        net.send_input(nid, [f"tx-{nid}-{epoch_idx}"])
+    net.crank_until(
+        lambda n: all(
+            len(batches_of(n, i)) > epoch_idx for i in n.correct_ids
+        ),
+        max_cranks=2_000_000,
+    )
+
+
+def test_batches_agree_no_change():
+    net = build_dhb_net(n=4, seed=3, adversary=ReorderingAdversary())
+    drive_epoch(net, 0)
+    drive_epoch(net, 1)
+    ref = batches_of(net, 0)[:2]
+    assert [b.era for b in ref] == [0, 0]
+    assert all(b.change == ChangeState.none() for b in ref)
+    for nid in net.correct_ids[1:]:
+        assert batches_of(net, nid)[:2] == ref
+    assert net.correct_faults() == []
+
+
+def test_vote_remove_validator_era_change():
+    net = build_dhb_net(n=4, seed=4)
+    victim = 3
+    new_map = {
+        i: net.node(0).netinfo.public_key(i)
+        for i in net.node(0).netinfo.all_ids
+        if i != victim
+    }
+    change = Change.node_change(new_map)
+    for nid in net.correct_ids:
+        node = net.node(nid)
+        step = node.protocol.vote_for(change, node.rng)
+        net._process_step(node, step)
+
+    epoch = 0
+    max_epochs = 12
+    while not all(
+        any(b.change.kind == "complete" for b in batches_of(net, i))
+        for i in net.correct_ids
+    ):
+        assert epoch < max_epochs, "era change did not complete"
+        drive_epoch(net, epoch)
+        epoch += 1
+
+    # All correct nodes completed the SAME change and agree on the plan.
+    plans = {}
+    for nid in net.correct_ids:
+        done = [b for b in batches_of(net, nid) if b.change.kind == "complete"]
+        assert done[0].change.change == change
+        plans[nid] = done[0].join_plan
+    ref = plans[net.correct_ids[0]]
+    assert all(p == ref for p in plans.values())
+    assert ref.era == 1
+    assert sorted(ref.validator_map()) == sorted(new_map)
+
+    # The new era works: removed node is an observer, others validate.
+    assert not net.node(victim).protocol.netinfo.is_validator()
+    remaining = [i for i in net.correct_ids if i != victim]
+    for nid in remaining:
+        assert net.node(nid).protocol.netinfo.is_validator()
+        assert net.node(nid).protocol.era == 1
+
+    start = len(batches_of(net, remaining[0]))
+    for nid in remaining:
+        net.send_input(nid, [f"era1-tx-{nid}"])
+    net.crank_until(
+        lambda n: all(len(batches_of(n, i)) > start for i in remaining),
+        max_cranks=2_000_000,
+    )
+    era1 = [b for b in batches_of(net, remaining[0]) if b.era == 1]
+    assert era1, "no era-1 batches"
+    assert net.correct_faults() == []
+
+
+def test_vote_add_observer_becomes_validator():
+    net = build_dhb_net(n=5, seed=5, observers=1)
+    newcomer = 4
+    assert not net.node(newcomer).protocol.netinfo.is_validator()
+    base = net.node(0).netinfo
+    new_map = {i: base.public_key(i) for i in base.all_ids}
+    new_map[newcomer] = net.node(newcomer).protocol.netinfo.secret_key.public_key()
+    change = Change.node_change(new_map)
+    for nid in base.all_ids:
+        node = net.node(nid)
+        step = node.protocol.vote_for(change, node.rng)
+        net._process_step(node, step)
+
+    epoch = 0
+    while not all(
+        any(b.change.kind == "complete" for b in batches_of(net, i))
+        for i in net.correct_ids
+    ):
+        assert epoch < 12, "era change did not complete"
+        drive_epoch(net, epoch, proposers=list(base.all_ids))
+        epoch += 1
+
+    assert net.node(newcomer).protocol.era == 1
+    assert net.node(newcomer).protocol.netinfo.is_validator()
+    assert net.node(newcomer).protocol.netinfo.secret_key_share is not None
+
+    # The promoted node proposes in era 1 and its contribution commits —
+    # proof the new threshold keys (from the embedded DKG) actually work.
+    start = max(len(batches_of(net, i)) for i in net.correct_ids)
+    for nid in net.correct_ids:
+        net.send_input(nid, [f"era1-{nid}"])
+    net.crank_until(
+        lambda n: any(
+            b.era == 1 and newcomer in b.contribution_map()
+            for i in n.correct_ids
+            for b in batches_of(n, i)
+        ),
+        max_cranks=2_000_000,
+    )
+    assert net.correct_faults() == []
+
+
+def test_encryption_schedule_change():
+    net = build_dhb_net(n=4, seed=6)
+    change = Change.encryption_schedule(EncryptionSchedule.never())
+    for nid in net.correct_ids:
+        node = net.node(nid)
+        net._process_step(node, node.protocol.vote_for(change, node.rng))
+    drive_epoch(net, 0)
+    done = [b for b in batches_of(net, 0) if b.change.kind == "complete"]
+    assert done and done[0].change.change == change
+    assert net.node(0).protocol.era == 1
+    assert net.node(0).protocol.encryption_schedule == EncryptionSchedule.never()
+    assert net.correct_faults() == []
+
+
+def test_join_plan_construction():
+    """from_join_plan yields an observer aligned with the plan's era."""
+    suite = ScalarSuite()
+    net = build_dhb_net(n=4, seed=7)
+    victim = 3
+    new_map = {
+        i: net.node(0).netinfo.public_key(i)
+        for i in net.node(0).netinfo.all_ids
+        if i != victim
+    }
+    for nid in net.correct_ids:
+        node = net.node(nid)
+        net._process_step(
+            node, node.protocol.vote_for(Change.node_change(new_map), node.rng)
+        )
+    epoch = 0
+    while not any(b.change.kind == "complete" for b in batches_of(net, 0)):
+        assert epoch < 12
+        drive_epoch(net, epoch)
+        epoch += 1
+    plan = [b for b in batches_of(net, 0) if b.change.kind == "complete"][0].join_plan
+    sk = SecretKey.random(random.Random(99), suite)
+    from hbbft_tpu.crypto.pool import VerifyPool
+
+    joiner = DynamicHoneyBadger.from_join_plan(
+        "joiner", sk, plan, VerifyPool(), session_id=b"dhb-test"
+    )
+    assert joiner.era == plan.era
+    assert not joiner.netinfo.is_validator()
+    assert sorted(joiner.netinfo.all_ids) == sorted(plan.validator_map())

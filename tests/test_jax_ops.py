@@ -1,0 +1,55 @@
+"""Device-side data-plane ops vs host implementations.
+
+Reference behavior: ``reed-solomon-erasure`` + ``tiny-keccak`` as used by
+upstream ``src/broadcast`` (SURVEY.md §2 #4), here as GF(2) bit-matmuls
+and batched Keccak-f[1600] (hbbft_tpu/ops/jaxops/).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hbbft_tpu.ops import gf256 as host_gf
+from hbbft_tpu.ops import merkle as host_merkle
+from hbbft_tpu.ops.jaxops import gf256 as jgf
+from hbbft_tpu.ops.jaxops import keccak as jk
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(9)
+
+
+def test_sha3_matches_hashlib(rng):
+    for m in (0, 1, 64, 65, 135):
+        msgs = rng.integers(0, 256, size=(5, m), dtype=np.uint8)
+        got = jk.sha3_256_batch(msgs)
+        for i in range(5):
+            assert bytes(got[i]) == hashlib.sha3_256(bytes(msgs[i])).digest()
+
+
+def test_merkle_level_matches_host(rng):
+    pairs = rng.integers(0, 256, size=(8, 64), dtype=np.uint8)
+    got = jk.merkle_level(0x01, pairs)
+    for i in range(8):
+        left, right = bytes(pairs[i, :32]), bytes(pairs[i, 32:])
+        assert bytes(got[i]) == host_merkle._h_branch(left, right)
+
+
+@pytest.mark.parametrize("k,n", [(2, 3), (4, 7), (6, 10)])
+def test_rs_encode_matches_host(rng, k, n):
+    data = [bytes(rng.integers(0, 256, 48, dtype=np.uint8)) for _ in range(k)]
+    assert jgf.ReedSolomonJax(k, n).encode(data) == host_gf.ReedSolomon(k, n).encode(data)
+
+
+def test_rs_reconstruct_roundtrip(rng):
+    k, n = 4, 7
+    rs = jgf.ReedSolomonJax(k, n)
+    data = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(k)]
+    shards = rs.encode(data)
+    # every k-subset of shards reconstructs the data
+    import itertools
+
+    for idxs in itertools.combinations(range(n), k):
+        assert rs.reconstruct({i: shards[i] for i in idxs}) == data

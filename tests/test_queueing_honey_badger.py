@@ -1,0 +1,111 @@
+"""QueueingHoneyBadger + SenderQueue tests.
+
+Reference analogs: upstream ``tests/queueing_honey_badger.rs`` (every
+pushed transaction eventually commits, exactly once per node's view) and
+the sender-queue epoch-gating semantics of ``src/sender_queue/``.
+"""
+
+from hbbft_tpu.net import NetBuilder, ReorderingAdversary
+from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import SenderQueue, SqMessage
+
+
+def build_qhb_net(n=4, seed=0, batch_size=8, adversary=None, sender_queue=False, f=0):
+    def factory(ni, sink, rng):
+        if sender_queue:
+            return SenderQueue.wrap(
+                lambda s: QueueingHoneyBadger(
+                    ni, s, batch_size=batch_size, session_id=b"qhb-test"
+                ),
+                sink,
+                peers=list(range(n)),
+            )
+        return QueueingHoneyBadger(
+            ni, sink, batch_size=batch_size, session_id=b"qhb-test"
+        )
+
+    b = NetBuilder(n, seed=seed).num_faulty(f).protocol(factory)
+    if adversary is not None:
+        b = b.adversary(adversary)
+    return b.build()
+
+
+def committed_txns(net, nid):
+    txns = []
+    for out in net.node(nid).outputs:
+        if isinstance(out, DhbBatch):
+            for _, contrib in out.contributions:
+                txns.extend(contrib)
+    return txns
+
+
+def test_all_transactions_commit():
+    net = build_qhb_net(n=4, seed=11, adversary=ReorderingAdversary())
+    all_txns = [f"txn-{nid}-{k}" for nid in net.correct_ids for k in range(6)]
+    for nid in net.correct_ids:
+        for k in range(6):
+            net.send_input(nid, Input.user(f"txn-{nid}-{k}"))
+    net.crank_until(
+        lambda n: all(
+            set(all_txns) <= set(committed_txns(n, i)) for i in n.correct_ids
+        ),
+        max_cranks=2_000_000,
+    )
+    for nid in net.correct_ids:
+        got = committed_txns(net, nid)
+        # exactly-once: no transaction commits twice
+        assert len(got) == len(set(got))
+    assert net.correct_faults() == []
+
+
+def test_change_via_input():
+    net = build_qhb_net(n=4, seed=12)
+    victim = 3
+    ni = net.node(0).protocol.netinfo
+    new_map = {i: ni.public_key(i) for i in ni.all_ids if i != victim}
+    for nid in net.correct_ids:
+        net.send_input(nid, Input.change(Change.node_change(new_map)))
+        net.send_input(nid, Input.user(f"seed-{nid}"))
+    net.crank_until(
+        lambda n: all(
+            any(
+                isinstance(o, DhbBatch) and o.change.kind == "complete"
+                for o in n.node(i).outputs
+            )
+            for i in n.correct_ids
+        ),
+        max_cranks=2_000_000,
+    )
+    assert net.node(victim).protocol.netinfo.is_validator() is False
+    assert net.correct_faults() == []
+
+
+def test_sender_queue_wrapped_progress():
+    net = build_qhb_net(n=4, seed=13, sender_queue=True)
+    all_txns = [f"sq-{nid}-{k}" for nid in net.correct_ids for k in range(3)]
+    for nid in net.correct_ids:
+        for k in range(3):
+            net.send_input(nid, Input.user(f"sq-{nid}-{k}"))
+    net.crank_until(
+        lambda n: all(
+            set(all_txns) <= set(committed_txns(n, i)) for i in n.correct_ids
+        ),
+        max_cranks=2_000_000,
+    )
+    assert net.correct_faults() == []
+
+
+def test_sender_queue_gates_future_messages():
+    """A peer stuck at (0,0) only receives messages within its window."""
+    net = build_qhb_net(n=4, seed=14, sender_queue=True)
+    sq: SenderQueue = net.node(0).protocol
+    assert isinstance(sq, SenderQueue)
+    far_future = (0, 99)
+    step = type(sq.inner.dhb)._make_hb  # just to assert type wiring exists
+    verdict = sq._admits((0, 0), far_future)
+    assert verdict == "hold"
+    assert sq._admits((0, 99), (0, 99)) == "send"
+    assert sq._admits((1, 0), (0, 5)) == "drop"
+    assert sq._admits((0, 5), (0, 3)) == "drop"
+    assert sq._admits((0, 0), (1, 0)) == "hold"
