@@ -28,6 +28,17 @@ class _PointElem:
         self._affine: Any = _UNSET
         self._bytes: Optional[bytes] = None
 
+    def __getstate__(self):
+        # Drop the lazy caches: the _UNSET sentinel does not survive
+        # pickling by identity (a round-trip would resurrect it as an
+        # arbitrary object that affine() then hands out as coordinates).
+        return self.jac
+
+    def __setstate__(self, state):
+        self.jac = state
+        self._affine = _UNSET
+        self._bytes = None
+
     # -- group ops -----------------------------------------------------
     def __add__(self, other: "_PointElem"):
         return type(self)(C.jac_add(self.ops, self.jac, other.jac))

@@ -20,6 +20,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+try:
+    from hbbft_tpu.ops import native as _native
+except Exception:  # pragma: no cover - native plane is optional
+    _native = None
+
 _POLY = 0x11D
 
 EXP = np.zeros(512, dtype=np.uint8)
@@ -128,6 +133,10 @@ class ReedSolomon:
         assert len(data_shards) == self.k
         size = len(data_shards[0])
         assert all(len(s) == size for s in data_shards)
+        if _native is not None and _native.available():
+            out = _native.rs_encode(data_shards, self.n)
+            if out is not None:
+                return out
         data = np.frombuffer(b"".join(data_shards), dtype=np.uint8).reshape(
             self.k, size
         )
@@ -138,6 +147,10 @@ class ReedSolomon:
         """Any k shards (by index) -> the k data shards."""
         if len(shards) < self.k:
             raise ValueError(f"need {self.k} shards, got {len(shards)}")
+        if _native is not None and _native.available():
+            out = _native.rs_reconstruct(shards, self.k, self.n)
+            if out is not None:
+                return out
         idxs = sorted(shards)[: self.k]
         size = len(shards[idxs[0]])
         sub = self.matrix[idxs]

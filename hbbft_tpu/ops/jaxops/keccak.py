@@ -113,7 +113,17 @@ def pad_block(msgs: np.ndarray) -> np.ndarray:
 
 
 def sha3_256_block(padded: np.ndarray) -> jnp.ndarray:
-    """(batch, RATE) padded blocks -> (batch, 32) uint8 digests."""
+    """(batch, RATE) padded blocks -> (batch, 32) uint8 digests.
+
+    On TPU the permutation dispatches to the fused Pallas kernel
+    (ops/jaxops/keccak_pallas.py); elsewhere the jnp path below runs.
+    """
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from hbbft_tpu.ops.jaxops import keccak_pallas as _kp
+
+        return _kp.sha3_256_block(padded)
     batch = padded.shape[0]
     words = np.zeros((batch, 25, 2), dtype=np.uint32)
     as_u32 = padded.reshape(batch, RATE // 4, 4)

@@ -11,6 +11,11 @@ import hashlib
 from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
+try:
+    from hbbft_tpu.ops import native as _native
+except Exception:  # pragma: no cover - native plane is optional
+    _native = None
+
 
 def _h_leaf(data: bytes) -> bytes:
     return hashlib.sha3_256(b"\x00" + data).digest()
@@ -80,6 +85,16 @@ class MerkleTree:
         assert leaves, "empty tree"
         self.leaves = list(leaves)
         n = len(self.leaves)
+        leaf_len = len(self.leaves[0])
+        if (
+            _native is not None
+            and _native.available()
+            and all(len(v) == leaf_len for v in self.leaves)
+        ):
+            # Native C++ fast path (equal-length leaves, the Broadcast
+            # shard case); bit-identical to the fallback below.
+            self.levels = _native.merkle_levels(self.leaves)
+            return
         size = 1 << _depth(n)
         level = [_h_leaf(v) for v in self.leaves]
         level += [_h_leaf(b"")] * (size - n)

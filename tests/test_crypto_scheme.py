@@ -129,3 +129,29 @@ def test_batched_backend_matches_eager_and_isolates_faults(rng, suite):
     expected[3] = False
     expected[6] = False
     assert batched == expected
+
+
+def test_bls_elements_survive_pickling():
+    """Serde round-trips (Broadcast pickles ciphertexts into RS shards)
+    must not corrupt the lazy affine/bytes caches of point elements."""
+    import pickle
+
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    rng = random.Random(5)
+    sks = SecretKeySet.random(1, rng, suite)
+    pks = sks.public_keys()
+    ct = pks.public_key().encrypt(b"pickled payload", rng)
+    ct2 = pickle.loads(pickle.dumps(ct))
+    assert ct2.to_bytes() == ct.to_bytes()
+    sig = sks.secret_key_share(0).sign(b"msg")
+    sig2 = pickle.loads(pickle.dumps(sig))
+    assert sig2.g2 == sig.g2 and sig2.g2.to_bytes() == sig.g2.to_bytes()
+    # Pickled points still verify.
+    from hbbft_tpu.crypto.backend import EagerBackend, VerifyRequest
+
+    ok = EagerBackend(suite).verify_batch(
+        [VerifyRequest.sig_share(pks.public_key_share(0), b"msg", sig2)]
+    )
+    assert ok == [True]

@@ -1,0 +1,195 @@
+"""Property-based tests over (N, f, seed) — upstream ``tests/net/proptest.rs``.
+
+The reference generates network dimensions and RNG seeds with proptest
+and asserts the universal protocol invariants (all correct nodes
+terminate, outputs agree, no faults recorded against correct nodes);
+failures shrink to minimal configurations.  Hypothesis plays that role
+here.  Everything is seeded — a failing example replays exactly.
+"""
+
+import random
+
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from hbbft_tpu.net import (
+    NetBuilder,
+    NodeOrderAdversary,
+    NullAdversary,
+    RandomAdversary,
+    ReorderingAdversary,
+)
+from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.honey_badger import HoneyBadger
+from hbbft_tpu.protocols.subset import Subset, SubsetOutput
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+SETTINGS = settings(
+    max_examples=12,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+# Network dimensions: N and an f <= (N-1)//3 (possibly under-provisioned
+# with faulty nodes, like upstream's NetworkDimension strategy).
+dims = st.integers(min_value=1, max_value=13).flatmap(
+    lambda n: st.tuples(
+        st.just(n), st.integers(min_value=0, max_value=(n - 1) // 3)
+    )
+)
+seeds = st.integers(min_value=0, max_value=2**32 - 1)
+adversaries = st.sampled_from(
+    [NullAdversary, ReorderingAdversary, NodeOrderAdversary, RandomAdversary]
+)
+
+
+@SETTINGS
+@given(dim=dims, seed=seeds, adv=adversaries)
+def test_broadcast_agreement(dim, seed, adv):
+    n, f = dim
+    payload = random.Random(seed).randbytes(64)
+    net = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .adversary(adv())
+        .protocol(lambda ni, sink, rng: Broadcast(ni, 0))
+        .build()
+    )
+    if 0 not in net.correct_ids:
+        return  # proposer faulty: delivery is not guaranteed
+    net.send_input(0, payload)
+    net.run_to_termination(max_cranks=1_000_000)
+    for nid in net.correct_ids:
+        assert net.node(nid).outputs == [payload]
+    assert net.correct_faults() == []
+
+
+@SETTINGS
+@given(dim=dims, seed=seeds, adv=adversaries, inputs=st.integers(0, 2**13 - 1))
+def test_binary_agreement_properties(dim, seed, adv, inputs):
+    """Agreement + validity: one common decision; unanimous input wins."""
+    n, f = dim
+    net = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .adversary(adv())
+        .protocol(lambda ni, sink, rng: BinaryAgreement(ni, b"prop-aba", sink))
+        .build()
+    )
+    votes = {nid: bool((inputs >> i) & 1) for i, nid in enumerate(net.correct_ids)}
+    for nid, vote in votes.items():
+        net.send_input(nid, vote)
+    net.run_to_termination(max_cranks=2_000_000)
+    decisions = {tuple(net.node(nid).outputs) for nid in net.correct_ids}
+    assert len(decisions) == 1
+    (decision,) = decisions
+    assert len(decision) == 1
+    if len(set(votes.values())) == 1:
+        assert decision[0] == next(iter(votes.values()))
+    assert net.correct_faults() == []
+
+
+@SETTINGS
+@given(dim=dims, seed=seeds)
+def test_subset_agreement(dim, seed):
+    n, f = dim
+    net = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .protocol(lambda ni, sink, rng: Subset(ni, b"prop-acs", sink))
+        .build()
+    )
+    for nid in net.correct_ids:
+        net.send_input(nid, f"contrib-{nid}".encode())
+    net.run_to_termination(max_cranks=2_000_000)
+    outs = {
+        nid: {
+            (o.proposer, o.value)
+            for o in net.node(nid).outputs
+            if isinstance(o, SubsetOutput) and o.kind == "contribution"
+        }
+        for nid in net.correct_ids
+    }
+    sets = list(outs.values())
+    assert all(s == sets[0] for s in sets)
+    n_val = len(net.correct_ids) + len(net.faulty_ids)
+    assert len(sets[0]) >= n_val - f
+    assert net.correct_faults() == []
+
+
+@SETTINGS
+@given(dim=dims, seed=seeds)
+def test_threshold_sign_unique_signature(dim, seed):
+    n, f = dim
+    net = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, b"prop-doc", sink))
+        .build()
+    )
+    for nid in net.correct_ids:
+        net.send_input(nid, None)
+    net.run_to_termination(max_cranks=1_000_000)
+    sigs = {net.node(nid).outputs[0].to_bytes() for nid in net.correct_ids}
+    assert len(sigs) == 1
+    assert net.correct_faults() == []
+
+
+@SETTINGS
+@given(seed=seeds, n=st.integers(min_value=2, max_value=7))
+def test_honey_badger_epoch_agreement(seed, n):
+    f = (n - 1) // 3
+    net = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .protocol(
+            lambda ni, sink, rng: HoneyBadger(ni, sink, session_id=b"prop-hb")
+        )
+        .build()
+    )
+    for nid in net.correct_ids:
+        net.send_input(nid, [f"tx-{nid}"])
+    net.crank_until(
+        lambda net_: all(net_.node(i).outputs for i in net_.correct_ids),
+        max_cranks=2_000_000,
+    )
+    batches = {nid: net.node(nid).outputs[0] for nid in net.correct_ids}
+    views = {
+        tuple(
+            (p, tuple(c) if isinstance(c, list) else c)
+            for p, c in sorted(b.contributions)
+        )
+        for b in batches.values()
+    }
+    assert len(views) == 1
+    assert net.correct_faults() == []
+
+
+def test_determinism_same_seed_same_transcript():
+    """Same seed ⇒ byte-identical run (SURVEY §5.2's sanitizer analog)."""
+
+    def run(seed):
+        net = (
+            NetBuilder(6, seed=seed)
+            .adversary(RandomAdversary())
+            .protocol(
+                lambda ni, sink, rng: HoneyBadger(ni, sink, session_id=b"det")
+            )
+            .build()
+        )
+        for nid in net.correct_ids:
+            net.send_input(nid, [f"tx-{nid}"])
+        net.crank_until(
+            lambda net_: all(net_.node(i).outputs for i in net_.correct_ids),
+            max_cranks=2_000_000,
+        )
+        return [
+            (nid, [sorted(b.contributions) for b in net.node(nid).outputs])
+            for nid in net.correct_ids
+        ], net.delivered
+
+    a1, a2, b = run(1234), run(1234), run(4321)
+    assert a1 == a2
+    # Different seed takes a different path (delivery order differs).
+    assert a1[1] != b[1] or a1[0] == b[0]
