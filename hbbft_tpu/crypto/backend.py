@@ -68,7 +68,9 @@ class CryptoBackend(abc.ABC):
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]: ...
 
 
-def request_well_formed(suite: Suite, req: VerifyRequest) -> bool:
+def request_well_formed(
+    suite: Suite, req: VerifyRequest, subgroup: bool = True
+) -> bool:
     """Structural validation of a request built from wire data.
 
     Byzantine peers can put arbitrary objects where group elements belong;
@@ -80,6 +82,12 @@ def request_well_formed(suite: Suite, req: VerifyRequest) -> bool:
     DEC_SHARE request was already vetted by a prior CIPHERTEXT request
     (``ThresholdDecrypt`` gates share submission on ciphertext validity),
     so those get the cheap structural check.
+
+    ``subgroup=False`` skips the torsion checks entirely (on-curve and
+    structure only) — for backends that run the subgroup checks
+    themselves, batched on device (``TpuBackend``).  A host subgroup
+    check costs one 255-bit scalar multiplication in Python PER REQUEST
+    and dominates the whole flush otherwise.
     """
     if req.kind not in (SIG_SHARE, DEC_SHARE, CIPHERTEXT):
         raise ValueError(f"unknown request kind {req.kind!r}")  # local bug
@@ -91,7 +99,7 @@ def request_well_formed(suite: Suite, req: VerifyRequest) -> bool:
                 and suite.is_g1(pk.g1, check_subgroup=False)
                 and isinstance(msg, bytes)
                 and isinstance(share, SignatureShare)
-                and suite.is_g2(share.g2)
+                and suite.is_g2(share.g2, check_subgroup=subgroup)
             )
         if req.kind == DEC_SHARE:
             pk, ct, share = req.payload
@@ -100,10 +108,10 @@ def request_well_formed(suite: Suite, req: VerifyRequest) -> bool:
                 and suite.is_g1(pk.g1, check_subgroup=False)
                 and _ciphertext_well_formed(suite, ct, check_subgroup=False)
                 and isinstance(share, DecryptionShare)
-                and suite.is_g1(share.g1)
+                and suite.is_g1(share.g1, check_subgroup=subgroup)
             )
         (ct,) = req.payload
-        return _ciphertext_well_formed(suite, ct)
+        return _ciphertext_well_formed(suite, ct, check_subgroup=subgroup)
     except Exception:
         return False
 

@@ -6,8 +6,12 @@ coefficients, identical leg algebra, bisection fallback on failure — but
 the heavy group algebra runs on the accelerator in ONE jitted kernel:
 
 * every share/key/ciphertext point is scaled by its 128-bit RLC
-  coefficient with a batched double-and-add scan (the whole batch rides
-  the vector lanes),
+  coefficient with a batched LSB-first double-and-add scan that
+  SIMULTANEOUSLY computes ``[r-1]P`` off the same doubling chain — the
+  subgroup (r-torsion) check for wire-sourced points runs on device,
+  batched, instead of as per-request Python scalar-mults on the host
+  (which cost more than the entire device flush: BASELINE.md round-1
+  measurements),
 * per-leg sums are masked tree reductions,
 * the 1 + L pairing-product legs run through the batched Miller loop and
   one shared final exponentiation.
@@ -65,15 +69,33 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
 
     Inputs (all device arrays):
       g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, NBITS),
-      g1 leg one-hot (n_legs, n_g1);
-      g2 pts / bits (n_g2 …) — the generator leg;
+      g1 subgroup-check mask (n_g1,), g1 leg one-hot (n_legs, n_g1);
+      g2 pts / bits / mask (n_g2 …) — the generator leg;
       rhs G2 points (n_legs) to pair each G1 leg sum with.
-    Returns the single aggregate boolean.
+    Returns the single aggregate boolean: RLC pairing product == 1 AND
+    every masked wire-sourced point passes the batched r-torsion check
+    (the host only does structural/on-curve validation — a Python
+    subgroup check per request costs more than the whole device flush).
     """
 
-    def run(g1_pts, g1_bits, seg, g2_pts, g2_bits, rhs_g2, gen_pt):
-        scaled1 = dcurve.scalar_mul(dcurve.G1_OPS, g1_pts, g1_bits)
-        scaled2 = dcurve.scalar_mul(dcurve.G2_OPS, g2_pts, g2_bits)
+    def run(g1_pts, g1_bits, g1_chk, seg, g2_pts, g2_bits, g2_chk, rhs_g2, gen_pt):
+        # One LSB-first shared-doubling scan per group computes the RLC
+        # multiple AND [r-1]P together; bits are RM1_NBITS wide.
+        rm1_1 = jnp.broadcast_to(
+            jnp.asarray(dcurve.RM1_BITS_LSB), (n_g1, dcurve.RM1_NBITS)
+        )
+        rm1_2 = jnp.broadcast_to(
+            jnp.asarray(dcurve.RM1_BITS_LSB), (n_g2, dcurve.RM1_NBITS)
+        )
+        scaled1, tor1 = dcurve.scalar_mul2(dcurve.G1_OPS, g1_pts, g1_bits, rm1_1)
+        scaled2, tor2 = dcurve.scalar_mul2(dcurve.G2_OPS, g2_pts, g2_bits, rm1_2)
+        sub1 = dcurve.jac_eq_dev(
+            dcurve.G1_OPS, tor1, dcurve.neg(dcurve.G1_OPS, g1_pts)
+        )
+        sub2 = dcurve.jac_eq_dev(
+            dcurve.G2_OPS, tor2, dcurve.neg(dcurve.G2_OPS, g2_pts)
+        )
+        sub_ok = jnp.all(sub1 | (g1_chk == 0)) & jnp.all(sub2 | (g2_chk == 0))
         gen_leg = dcurve.tree_sum(dcurve.G2_OPS, scaled2)
         leg_sums = []
         for l in range(n_legs):
@@ -88,7 +110,7 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
         rhs = tuple(
             jnp.concatenate([jnp.stack([gen_leg[c]]), rhs_g2[c]]) for c in range(4)
         )
-        return dpairing.pairing_product_is_one(lhs, rhs)
+        return dpairing.pairing_product_is_one(lhs, rhs) & sub_ok
 
     return jax.jit(run)
 
@@ -105,12 +127,15 @@ class TpuBackend(CryptoBackend):
     def _build_legs(self, reqs: Sequence[VerifyRequest], coeffs: Sequence[int]):
         """Returns (g2_entries, g1_entries, rhs_points).
 
-        g2_entries: list of (scalar, oracle G2 jac) summed against the G1
-        generator.  g1_entries: list of (scalar, oracle G1 jac, leg_id).
-        rhs_points[leg_id]: oracle G2 jac each G1 leg pairs with.
+        g2_entries: list of (scalar, oracle G2 jac, check) summed against
+        the G1 generator.  g1_entries: (scalar, oracle G1 jac, leg_id,
+        check).  rhs_points[leg_id]: oracle G2 jac each G1 leg pairs with.
+        ``check`` = 1 marks wire-sourced points that need the device-side
+        r-torsion check (shares, ciphertext points); locally-derived
+        points (public-key shares, hash-to-curve outputs) are exempt.
         """
-        g2_entries: List[Tuple[int, Any]] = []
-        g1_entries: List[Tuple[int, Any, int]] = []
+        g2_entries: List[Tuple[int, Any, int]] = []
+        g1_entries: List[Tuple[int, Any, int, int]] = []
         rhs: List[Any] = []
         leg_of: Dict[bytes, int] = {}
 
@@ -123,26 +148,27 @@ class TpuBackend(CryptoBackend):
         for r, c in zip(reqs, coeffs):
             if r.kind == SIG_SHARE:
                 pk, msg, share = r.payload
-                g2_entries.append((c, share.g2.jac))
+                g2_entries.append((c, share.g2.jac, 1))
                 l = leg(canonical_bytes(b"m", msg), self.suite.hash_to_g2(msg).jac)
-                g1_entries.append((c, (-pk.g1).jac, l))
+                g1_entries.append((c, (-pk.g1).jac, l, 0))
             elif r.kind == DEC_SHARE:
                 pk, ct, share = r.payload
                 l = leg(
                     canonical_bytes(b"c", ct.hash_input()),
                     self.suite.hash_to_g2(ct.hash_input()).jac,
                 )
-                g1_entries.append((c, share.g1.jac, l))
+                g1_entries.append((c, share.g1.jac, l, 1))
                 lw = leg(canonical_bytes(b"w", ct.w.to_bytes()), ct.w.jac)
-                g1_entries.append((c, (-pk.g1).jac, lw))
+                g1_entries.append((c, (-pk.g1).jac, lw, 0))
             else:
                 (ct,) = r.payload
-                g2_entries.append((c, ct.w.jac))
+                g2_entries.append((c, ct.w.jac, 1))
                 l = leg(
                     canonical_bytes(b"c", ct.hash_input()),
                     self.suite.hash_to_g2(ct.hash_input()).jac,
                 )
-                g1_entries.append((c, (-ct.u).jac, l))
+                # -U is in the subgroup iff U is.
+                g1_entries.append((c, (-ct.u).jac, l, 1))
         return g2_entries, g1_entries, rhs
 
     def _aggregate_ok(self, reqs: Sequence[VerifyRequest]) -> bool:
@@ -156,25 +182,31 @@ class TpuBackend(CryptoBackend):
         ident1 = (1, 1, 0)
         ident2 = ((1, 0), (1, 0), (0, 0))
         g1_pts = dcurve.g1_to_dev(
-            [p for _, p, _ in g1e] + [ident1] * (n1 - len(g1e))
+            [p for _, p, _, _ in g1e] + [ident1] * (n1 - len(g1e))
         )
-        g1_bits = dcurve.scalars_to_bits(
-            [s for s, _, _ in g1e] + [0] * (n1 - len(g1e)), NBITS
+        g1_bits = dcurve.scalars_to_bits_lsb(
+            [s for s, _, _, _ in g1e] + [0] * (n1 - len(g1e)), dcurve.RM1_NBITS
         )
+        g1_chk = np.zeros(n1, dtype=np.int32)
         seg = np.zeros((nl, n1), dtype=np.int32)
-        for i, (_, _, l) in enumerate(g1e):
+        for i, (_, _, l, chk) in enumerate(g1e):
             seg[l, i] = 1
+            g1_chk[i] = chk
         g2_pts = dcurve.g2_to_dev(
-            [p for _, p in g2e] + [ident2] * (n2 - len(g2e))
+            [p for _, p, _ in g2e] + [ident2] * (n2 - len(g2e))
         )
-        g2_bits = dcurve.scalars_to_bits(
-            [s for s, _ in g2e] + [0] * (n2 - len(g2e)), NBITS
+        g2_bits = dcurve.scalars_to_bits_lsb(
+            [s for s, _, _ in g2e] + [0] * (n2 - len(g2e)), dcurve.RM1_NBITS
         )
+        g2_chk = np.zeros(n2, dtype=np.int32)
+        for i, (_, _, chk) in enumerate(g2e):
+            g2_chk[i] = chk
         rhs_pts = dcurve.g2_to_dev(rhs + [ident2] * (nl - len(rhs)))
         gen_pt = dcurve.g1_to_dev([ocurve.G1_GEN])
         gen_pt = tuple(x[0] for x in gen_pt)
         ok = _kernel(n1, n2, nl)(
-            g1_pts, g1_bits, jnp.asarray(seg), g2_pts, g2_bits, rhs_pts, gen_pt
+            g1_pts, g1_bits, jnp.asarray(g1_chk), jnp.asarray(seg),
+            g2_pts, g2_bits, jnp.asarray(g2_chk), rhs_pts, gen_pt
         )
         return bool(ok)
 
@@ -185,7 +217,13 @@ class TpuBackend(CryptoBackend):
         if not reqs:
             return []
         out = [False] * len(reqs)
-        idxs = [i for i, r in enumerate(reqs) if request_well_formed(self.suite, r)]
+        # Host: structure + on-curve only; the r-torsion checks run
+        # batched inside the flush kernel (subgroup=False here).
+        idxs = [
+            i
+            for i, r in enumerate(reqs)
+            if request_well_formed(self.suite, r, subgroup=False)
+        ]
         self._verify_range(reqs, idxs, out)
         return out
 

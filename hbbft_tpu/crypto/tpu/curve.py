@@ -181,6 +181,114 @@ def scalar_mul(ops: Ops, base: Point, bits: jnp.ndarray) -> Point:
     return (x, y, z, inf)
 
 
+def jac_eq_dev(ops: Ops, p: Point, q: Point) -> jnp.ndarray:
+    """Batched projective equality (cross-multiplied), device-side.
+
+    Contains ``is_zero`` sequential scans — once-per-flush use only.
+    Points whose z is zero but whose infinity flag is unset (the garbage
+    add_unsafe produces on forbidden inputs) compare UNEQUAL to
+    everything, so downstream checks fail closed.
+    """
+    x1, y1, z1, i1 = p
+    x2, y2, z2, i2 = q
+    z1z1 = ops.sqr(z1)
+    z2z2 = ops.sqr(z2)
+    ex = ops.is_zero(ops.sub(ops.mul(x1, z2z2), ops.mul(x2, z1z1)))
+    ey = ops.is_zero(
+        ops.sub(
+            ops.mul(y1, ops.mul(z2, z2z2)), ops.mul(y2, ops.mul(z1, z1z1))
+        )
+    )
+    z_ok = (~ops.is_zero(z1)) & (~ops.is_zero(z2))
+    both_fin = (i1 == 0) & (i2 == 0)
+    both_inf = (i1 == 1) & (i2 == 1)
+    return both_inf | (both_fin & z_ok & ex & ey)
+
+
+# Bits of r-1 (r = subgroup order).  [r-1]P == -P iff P is in the
+# r-torsion subgroup; the double-and-add prefixes of r-1 never hit
+# add_unsafe's forbidden cases for subgroup points: the unsafe add
+# add([2k]P, P) needs 2k ≡ ±1 (mod r), and every prefix satisfies
+# 2k + 1 <= r-1 with 2k even, so neither branch can occur.
+RM1_NBITS = (F.R - 1).bit_length()  # 255
+_RM1_BITS = np.asarray(
+    [(int(F.R - 1) >> i) & 1 for i in reversed(range(RM1_NBITS))],
+    dtype=np.int32,
+)
+RM1_BITS_LSB = _RM1_BITS[::-1].copy()
+
+
+def subgroup_check(ops: Ops, pts: Point) -> jnp.ndarray:
+    """Batched r-torsion membership: [r-1]P == -P (True for identity).
+
+    Replaces the reference's per-point CPU subgroup validation (pairing
+    crate ``is_torsion_free``-style checks) with one batched 255-bit
+    scalar multiplication.
+    """
+    n = pts[0].shape[0]
+    bits = jnp.broadcast_to(jnp.asarray(_RM1_BITS), (n, _RM1_BITS.shape[0]))
+    q = scalar_mul(ops, pts, bits)
+    return jac_eq_dev(ops, q, neg(ops, pts))
+
+
+def scalar_mul2(
+    ops: Ops, base: Point, bits_a: jnp.ndarray, bits_b: jnp.ndarray
+) -> Tuple[Point, Point]:
+    """Two scalar multiples of the SAME base per batch element, one scan.
+
+    LSB-first double-and-add sharing the base-doubling chain: per step
+    one double (of the base) + two conditional adds, so computing
+    ``[a]P`` and ``[b]P`` together costs ~35% less than two MSB-first
+    scans and halves the number of compiled scan bodies.  ``bits_a``/
+    ``bits_b``: (..., nbits) int32, LSB FIRST, equal width (pad the
+    shorter scalar with zero bits).
+
+    add_unsafe safety (on top of the module-docstring argument): the
+    accumulator after k steps holds ``(m mod 2^k)·P`` (fixed scalar) or a
+    committed-coefficient partial sum (Fiat-Shamir), and the addend is
+    ``2^k·P``; coincidence needs m mod 2^k ≡ ±2^k (mod r), impossible
+    for m = r-1 and negligible for random coefficients.
+    """
+    assert bits_a.shape == bits_b.shape
+    batch = bits_a.shape[:-1]
+    acc_a = identity(ops, batch)
+    acc_b = identity(ops, batch)
+    started_a = jnp.zeros(batch, dtype=jnp.int32)
+    started_b = jnp.zeros(batch, dtype=jnp.int32)
+    xs = (jnp.moveaxis(bits_a, -1, 0), jnp.moveaxis(bits_b, -1, 0))
+
+    def acc_step(acc, started, cur, bit):
+        summed = add_unsafe(ops, (acc[0], acc[1], acc[2], 1 - started), cur)
+        return select(bit, summed, acc, ops), started | bit
+
+    def step(carry, bits):
+        acc_a, started_a, acc_b, started_b, cur = carry
+        bit_a, bit_b = bits
+        acc_a, started_a = acc_step(acc_a, started_a, cur, bit_a)
+        acc_b, started_b = acc_step(acc_b, started_b, cur, bit_b)
+        return (acc_a, started_a, acc_b, started_b, double(ops, cur)), None
+
+    (acc_a, started_a, acc_b, started_b, _), _ = jax.lax.scan(
+        step, (acc_a, started_a, acc_b, started_b, base), xs
+    )
+    inf_a = (1 - started_a) | base[3]
+    inf_b = (1 - started_b) | base[3]
+    return (
+        (acc_a[0], acc_a[1], acc_a[2], inf_a),
+        (acc_b[0], acc_b[1], acc_b[2], inf_b),
+    )
+
+
+def scalars_to_bits_lsb(scalars, nbits: int) -> jnp.ndarray:
+    """Host: list of ints -> (N, nbits) int32 LSB-first bit matrix."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        assert 0 <= s < (1 << nbits)
+        for j in range(nbits):
+            out[i, j] = (s >> j) & 1
+    return jnp.asarray(out)
+
+
 def tree_sum(ops: Ops, pts: Point) -> Point:
     """Sum a batch of points over axis 0 (log2 rounds of add_unsafe)."""
     n = pts[0].shape[0]

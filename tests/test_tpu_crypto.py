@@ -259,3 +259,46 @@ def test_tpu_backend_isolates_bad_shares():
     got = TpuBackend(suite).verify_batch(reqs)
     assert got[:-1] == [True] * (len(reqs) - 1)
     assert got[-1] is False or got[-1] == False  # noqa: E712
+
+
+def test_device_subgroup_check_and_rejection():
+    """The batched r-torsion check accepts subgroup points/identity and
+    rejects on-curve points outside the subgroup; TpuBackend rejects a
+    share forged from a non-subgroup point (host does only structural
+    checks — the torsion check lives in the kernel)."""
+    import hashlib as _h
+
+    from hbbft_tpu.crypto.bls.suite import G2Elem
+    from hbbft_tpu.crypto.keys import SignatureShare
+
+    suite = BLSSuite()
+    # A G2 curve point NOT in the r-torsion subgroup: a twist point
+    # without cofactor clearing.
+    pt = oc._twist_sample_point()
+    rogue = G2Elem(pt)
+    assert suite.is_g2(rogue, check_subgroup=False)
+    assert not suite.is_g2(rogue)  # oracle agrees it's outside
+
+    gen = suite.g2_generator()
+    pts = dc.g2_to_dev([rogue.jac, gen.jac, (gen * 12345).jac,
+                        suite.g2_identity().jac])
+    ok = np.asarray(dc.subgroup_check(dc.G2_OPS, pts))
+    assert list(ok) == [False, True, True, True]
+
+    # End-to-end: a forged share built on the rogue point must fail in
+    # TpuBackend (and the honest shares around it must still pass).
+    rng_ = random.Random(77)
+    sks = SecretKeySet.random(1, rng_, suite)
+    pks = sks.public_keys()
+    msg = b"subgroup test doc"
+    reqs = [
+        VerifyRequest.sig_share(
+            pks.public_key_share(i), msg, sks.secret_key_share(i).sign(msg)
+        )
+        for i in range(3)
+    ]
+    reqs.append(
+        VerifyRequest.sig_share(pks.public_key_share(3), msg, SignatureShare(rogue, suite))
+    )
+    got = TpuBackend(suite).verify_batch(reqs)
+    assert got == [True, True, True, False]
