@@ -281,12 +281,7 @@ def scalar_mul2(
 
 def scalars_to_bits_lsb(scalars, nbits: int) -> jnp.ndarray:
     """Host: list of ints -> (N, nbits) int32 LSB-first bit matrix."""
-    out = np.zeros((len(scalars), nbits), dtype=np.int32)
-    for i, s in enumerate(scalars):
-        assert 0 <= s < (1 << nbits)
-        for j in range(nbits):
-            out[i, j] = (s >> j) & 1
-    return jnp.asarray(out)
+    return jnp.asarray(_scalars_to_bits_np(scalars, nbits))
 
 
 def tree_sum(ops: Ops, pts: Point) -> Point:
@@ -316,30 +311,45 @@ def _slice_or_identity(pts: Point, half: int, n: int, ops: Ops) -> Point:
 
 
 def g1_to_dev(jacs) -> Point:
-    """Host: list of oracle G1 Jacobian points -> batched device point."""
-    xs, ys, zs, infs = [], [], [], []
-    for p in jacs:
-        x, y, z = p
-        is_inf = z % F.P == 0
-        infs.append(1 if is_inf else 0)
-        xs.append(fq.to_mont_np(1 if is_inf else x))
-        ys.append(fq.to_mont_np(1 if is_inf else y))
-        zs.append(fq.to_mont_np(0 if is_inf else z))
-    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
-            jnp.asarray(np.stack(zs)), jnp.asarray(np.array(infs, dtype=np.int32)))
+    """Host: list of oracle G1 Jacobian points -> batched device point.
+
+    Batch-vectorized (bytes + unpackbits): the per-limb Python loop
+    dominated host->device conversion at firehose batch sizes."""
+    n = len(jacs)
+    xs, ys, zs = [], [], []
+    infs = np.zeros(n, dtype=np.int32)
+    for i, (x, y, z) in enumerate(jacs):
+        if z % F.P == 0:
+            infs[i] = 1
+            x, y, z = 1, 1, 0
+        xs.append(x)
+        ys.append(y)
+        zs.append(z)
+    flat = fq.to_mont_batch(xs + ys + zs)
+    return (jnp.asarray(flat[:n]), jnp.asarray(flat[n : 2 * n]),
+            jnp.asarray(flat[2 * n :]), jnp.asarray(infs))
 
 
 def g2_to_dev(jacs) -> Point:
-    xs, ys, zs, infs = [], [], [], []
-    for p in jacs:
-        x, y, z = p
-        is_inf = z[0] % F.P == 0 and z[1] % F.P == 0
-        infs.append(1 if is_inf else 0)
-        xs.append(fq2.to_mont_np((1, 0) if is_inf else x))
-        ys.append(fq2.to_mont_np((1, 0) if is_inf else y))
-        zs.append(fq2.to_mont_np((0, 0) if is_inf else z))
-    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)),
-            jnp.asarray(np.stack(zs)), jnp.asarray(np.array(infs, dtype=np.int32)))
+    n = len(jacs)
+    coords: list = []
+    infs = np.zeros(n, dtype=np.int32)
+    pts = []
+    for i, (x, y, z) in enumerate(jacs):
+        if z[0] % F.P == 0 and z[1] % F.P == 0:
+            infs[i] = 1
+            x, y, z = (1, 0), (1, 0), (0, 0)
+        pts.append((x, y, z))
+    for sel in range(3):
+        for c in range(2):
+            coords.extend(p[sel][c] for p in pts)
+    flat = fq.to_mont_batch(coords)  # (6n, NL): x0 x1 y0 y1 z0 z1 blocks
+    def elem(block):
+        return jnp.asarray(
+            np.stack([flat[block * 2 * n : block * 2 * n + n],
+                      flat[block * 2 * n + n : (block + 1) * 2 * n]], axis=1)
+        )
+    return (elem(0), elem(1), elem(2), jnp.asarray(infs))
 
 
 def g1_from_dev(p: Point, idx=None):
@@ -361,11 +371,21 @@ def g2_from_dev(p: Point, idx=None):
     return (fq2.from_mont_int(x), fq2.from_mont_int(y), fq2.from_mont_int(z))
 
 
+def _scalars_to_bits_np(scalars, nbits: int) -> np.ndarray:
+    """(N, nbits) int32 LSB-first bit matrix, vectorized."""
+    nbytes = (nbits + 7) // 8
+    for s in scalars:
+        assert 0 <= s < (1 << nbits)
+    if not scalars:
+        return np.zeros((0, nbits), dtype=np.int32)
+    data = np.frombuffer(
+        b"".join(s.to_bytes(nbytes, "little") for s in scalars), dtype=np.uint8
+    ).reshape(len(scalars), nbytes)
+    return np.unpackbits(data, axis=1, bitorder="little")[:, :nbits].astype(
+        np.int32
+    )
+
+
 def scalars_to_bits(scalars, nbits: int) -> jnp.ndarray:
     """Host: list of ints -> (N, nbits) int32 MSB-first bit matrix."""
-    out = np.zeros((len(scalars), nbits), dtype=np.int32)
-    for i, s in enumerate(scalars):
-        assert 0 <= s < (1 << nbits)
-        for j in range(nbits):
-            out[i, nbits - 1 - j] = (s >> j) & 1
-    return jnp.asarray(out)
+    return jnp.asarray(_scalars_to_bits_np(scalars, nbits)[:, ::-1].copy())

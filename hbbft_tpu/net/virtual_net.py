@@ -24,6 +24,7 @@ from hbbft_tpu.net.adversary import Adversary, NullAdversary
 from hbbft_tpu.protocols.fault_log import FaultLog
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+from hbbft_tpu.utils.metrics import Metrics
 
 
 class CrankError(Exception):
@@ -128,6 +129,7 @@ class VirtualNet:
         self.cranks = 0
         self.delivered = 0
         self._since_flush = 0
+        self.metrics = Metrics()
 
     # -- introspection -------------------------------------------------
     @property
@@ -256,7 +258,9 @@ class VirtualNet:
         for nid in sorted(self.nodes):
             node = self.nodes[nid]
             while node.pool:
-                step = node.pool.flush(self.backend)
+                self.metrics.count("verify_requests", len(node.pool))
+                with self.metrics.timer("verify_flush"):
+                    step = node.pool.flush(self.backend)
                 self._process_step(node, step)
 
 

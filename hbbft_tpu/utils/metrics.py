@@ -1,0 +1,141 @@
+"""Structured metrics: counters, timers, and JAX profiler traces.
+
+Reference behavior: the reference has no metrics beyond ``log`` lines and
+the per-message CPU-time accounting of its simulation example (SURVEY.md
+§5.1/§5.5).  This framework's observability surface is richer because the
+crypto plane batches onto an accelerator — per-flush timing and batch
+sizes are the operational signal — while staying optional: a ``Metrics``
+instance is plain data, and nothing in the protocol plane requires one.
+
+Usage::
+
+    from hbbft_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    with m.timer("flush"):
+        pool.flush(backend)
+    m.count("verify_requests", 12)
+    print(m.report())
+
+``Metrics.trace(path)`` wraps ``jax.profiler.trace`` so a verify flush
+can be captured for TensorBoard without importing jax at module scope.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass
+class TimerStats:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+@dataclass
+class Metrics:
+    """Counters + timers; cheap enough to leave on."""
+
+    counters: Dict[str, int] = field(default_factory=lambda: defaultdict(int))
+    timers: Dict[str, TimerStats] = field(default_factory=dict)
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    @contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.timers.setdefault(name, TimerStats()).add(dt)
+
+    @contextmanager
+    def trace(self, logdir: str) -> Iterator[None]:
+        """JAX profiler capture (TensorBoard format); no-op without jax."""
+        try:
+            import jax
+
+            with jax.profiler.trace(logdir):
+                yield
+        except ImportError:  # pragma: no cover
+            yield
+
+    def merge(self, other: "Metrics") -> None:
+        for k, v in other.counters.items():
+            self.counters[k] += v
+        for k, st in other.timers.items():
+            mine = self.timers.setdefault(k, TimerStats())
+            mine.count += st.count
+            mine.total_s += st.total_s
+            mine.max_s = max(mine.max_s, st.max_s)
+
+    def report(self) -> str:
+        lines = []
+        if self.counters:
+            lines.append("counters:")
+            for k in sorted(self.counters):
+                lines.append(f"  {k:<40} {self.counters[k]}")
+        if self.timers:
+            lines.append("timers:  (count / mean ms / max ms / total s)")
+            for k in sorted(self.timers):
+                st = self.timers[k]
+                lines.append(
+                    f"  {k:<40} {st.count:>6} {st.mean_s * 1e3:>9.2f} "
+                    f"{st.max_s * 1e3:>9.2f} {st.total_s:>8.2f}"
+                )
+        return "\n".join(lines) or "(no metrics)"
+
+
+@dataclass
+class EpochStats:
+    """Per-epoch protocol observables (what the simulation table prints)."""
+
+    epoch: Tuple[int, int]
+    started_at: float
+    finished_at: Optional[float] = None
+    contributions: int = 0
+    txns: int = 0
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.started_at
+
+
+class EpochTracker:
+    """Collects EpochStats keyed by (era, epoch)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[Tuple[int, int], EpochStats] = {}
+
+    def start(self, epoch: Tuple[int, int], now: float) -> None:
+        self._stats.setdefault(epoch, EpochStats(epoch=epoch, started_at=now))
+
+    def finish(
+        self, epoch: Tuple[int, int], now: float, contributions: int, txns: int
+    ) -> None:
+        st = self._stats.setdefault(epoch, EpochStats(epoch=epoch, started_at=now))
+        if st.finished_at is None:
+            st.finished_at = now
+            st.contributions = contributions
+            st.txns = txns
+
+    def all(self) -> List[EpochStats]:
+        return [self._stats[k] for k in sorted(self._stats)]

@@ -1,0 +1,54 @@
+"""Metrics/observability subsystem (SURVEY.md §5.1/§5.5 analog)."""
+
+import time
+
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+from hbbft_tpu.utils.metrics import EpochTracker, Metrics
+
+
+def test_counters_and_timers():
+    m = Metrics()
+    m.count("a")
+    m.count("a", 4)
+    with m.timer("t"):
+        time.sleep(0.01)
+    with m.timer("t"):
+        pass
+    assert m.counters["a"] == 5
+    st = m.timers["t"]
+    assert st.count == 2 and st.total_s >= 0.01 and st.max_s >= 0.01
+    rep = m.report()
+    assert "a" in rep and "t" in rep
+
+
+def test_merge():
+    a, b = Metrics(), Metrics()
+    a.count("x", 2)
+    b.count("x", 3)
+    with b.timer("u"):
+        pass
+    a.merge(b)
+    assert a.counters["x"] == 5
+    assert a.timers["u"].count == 1
+
+
+def test_virtual_net_records_flush_metrics():
+    net = (
+        NetBuilder(4, seed=1)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, b"mdoc", sink))
+        .build()
+    )
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    assert net.metrics.counters["verify_requests"] > 0
+    assert net.metrics.timers["verify_flush"].count > 0
+
+
+def test_epoch_tracker():
+    t = EpochTracker()
+    t.start((0, 0), 1.0)
+    t.finish((0, 0), 3.5, contributions=4, txns=12)
+    t.finish((0, 0), 9.0, contributions=9, txns=99)  # first finish wins
+    (st,) = t.all()
+    assert st.latency_s == 2.5 and st.txns == 12
