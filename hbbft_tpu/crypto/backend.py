@@ -150,8 +150,28 @@ class EagerBackend(CryptoBackend):
         return out
 
 
+def _warm_affine_caches(suite: Suite, reqs: Sequence[VerifyRequest]) -> None:
+    """Batch-invert all points about to be serialized (one inversion per
+    group instead of two ``pow(·, -1, p)`` per request)."""
+    batch_affine = getattr(suite, "batch_affine", None)
+    if batch_affine is None:
+        return
+    pts = []
+    for r in reqs:
+        for obj in r.payload:
+            for attr in ("g1", "g2", "u", "w"):
+                v = getattr(obj, attr, None)
+                if v is not None:
+                    pts.append(v)
+    try:
+        batch_affine(pts)
+    except Exception:
+        pass  # fall back to lazy per-element conversion
+
+
 def _batch_coefficients(suite: Suite, reqs: Sequence[VerifyRequest]) -> List[int]:
     """Deterministic Fiat-Shamir RLC coefficients in [1, 2^128)."""
+    _warm_affine_caches(suite, reqs)
     parts = []
     for r in reqs:
         if r.kind == SIG_SHARE:

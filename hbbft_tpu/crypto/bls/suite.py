@@ -168,6 +168,51 @@ class BLSSuite(Suite):
         aff_pairs = [(a.affine(), b.affine()) for a, b in pairs]
         return PR.multi_pairing_is_one(aff_pairs)
 
+    def batch_affine(self, elems: Sequence[Any]) -> None:
+        """Warm the affine caches of many points with ONE field inversion
+        per group (Montgomery's batch-inversion trick).
+
+        ``to_bytes``/``affine`` otherwise cost two ``pow(·, -1, p)`` per
+        point, which dominates Fiat-Shamir coefficient derivation at
+        flush batch sizes (BASELINE.md round-1 measurements).  Non-point
+        objects and already-cached/identity points are skipped.
+        """
+        for cls, ops in ((G1Elem, C.FQ_OPS), (G2Elem, C.FQ2_OPS)):
+            todo = []
+            for e in elems:
+                if (
+                    type(e) is cls
+                    and e._affine is _UNSET
+                    and isinstance(e.jac, tuple)
+                    and len(e.jac) == 3
+                ):
+                    todo.append(e)
+            if not todo:
+                continue
+            finite = []
+            for e in todo:
+                if ops.is_zero(e.jac[2]):
+                    e._affine = None
+                else:
+                    finite.append(e)
+            if not finite:
+                continue
+            # prefix[i] = z_0 · … · z_{i-1}; one inversion of the total.
+            prefix = [ops.one]
+            for e in finite:
+                prefix.append(ops.mul(prefix[-1], e.jac[2]))
+            inv_acc = ops.inv(prefix[-1])
+            for e in reversed(finite):
+                z_inv = ops.mul(inv_acc, prefix[len(prefix) - 2])
+                prefix.pop()
+                inv_acc = ops.mul(inv_acc, e.jac[2])
+                zi2 = ops.sqr(z_inv)
+                x, y, _ = e.jac
+                e._affine = (
+                    ops.mul(x, zi2),
+                    ops.mul(y, ops.mul(zi2, z_inv)),
+                )
+
 
 def _fq_valid(v: Any) -> bool:
     return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < F.P

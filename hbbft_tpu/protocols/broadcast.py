@@ -12,9 +12,20 @@ BASELINE.json:8).  Protocol for proposer p, value v:
   re-hash to verify the root (catches a proposer that encoded garbage),
   then output the value.
 
-Per-node byte cost is O(|v| * N / K) instead of O(|v| * N).  (The
-``EchoHash``/``CanDecode`` optimizations of later upstream revisions are
-not implemented — fork parity unknown, see SURVEY.md evidentiary note.)
+Per-node byte cost is O(|v| * N / K) instead of O(|v| * N).
+
+Later upstream revisions add two bandwidth-optimization messages, both
+implemented here (SURVEY.md §2 #4 "EchoHash/CanDecode"):
+
+* ``CanDecode(root)`` — broadcast once a node holds K shards for a root:
+  "stop sending me full proofs".
+* ``EchoHash(root)`` — sent in place of a full ``Echo(proof)`` to peers
+  that have declared ``CanDecode``; counts toward the N - f Echo
+  threshold but carries no shard.
+
+Safety is unchanged: decoding still requires K locally-validated shards
+and a recomputed Merkle root match; the optimization only drops shard
+payloads to peers that declared they no longer need them.
 """
 
 from __future__ import annotations
@@ -25,7 +36,7 @@ from typing import Any, Dict, Optional, Tuple
 from hbbft_tpu.ops.gf256 import ReedSolomon
 from hbbft_tpu.ops.merkle import MerkleTree, Proof
 from hbbft_tpu.protocols.network_info import NetworkInfo
-from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step, Target
 
 FAULT_INVALID_PROOF = "broadcast:invalid-proof"
 FAULT_WRONG_INDEX = "broadcast:wrong-shard-index"
@@ -48,6 +59,20 @@ class EchoMsg:
 
 @dataclass(frozen=True)
 class ReadyMsg:
+    root: bytes
+
+
+@dataclass(frozen=True)
+class EchoHashMsg:
+    """Echo without the shard, for peers that declared CanDecode."""
+
+    root: bytes
+
+
+@dataclass(frozen=True)
+class CanDecodeMsg:
+    """Sender holds K shards for ``root`` and needs no more full Echos."""
+
     root: bytes
 
 
@@ -79,7 +104,10 @@ class Broadcast(ConsensusProtocol):
         self._data_shards = n - 2 * f
         self._rs = ReedSolomon(self._data_shards, n)
         self._echos: Dict[Any, Proof] = {}
+        self._echo_hashes: Dict[Any, bytes] = {}
         self._readys: Dict[Any, bytes] = {}
+        self._can_decode: Dict[Any, bytes] = {}  # peer -> root it can decode
+        self._can_decode_sent = False
         self._echo_sent = False
         self._ready_sent = False
         self._had_input = False
@@ -136,6 +164,14 @@ class Broadcast(ConsensusProtocol):
             if not isinstance(message.root, bytes):
                 return step.fault(sender, FAULT_MALFORMED)
             return self._handle_ready(sender, message.root)
+        if isinstance(message, EchoHashMsg):
+            if not isinstance(message.root, bytes):
+                return step.fault(sender, FAULT_MALFORMED)
+            return self._handle_echo_hash(sender, message.root)
+        if isinstance(message, CanDecodeMsg):
+            if not isinstance(message.root, bytes):
+                return step.fault(sender, FAULT_MALFORMED)
+            return self._handle_can_decode(sender, message.root)
         return step.fault(sender, FAULT_MALFORMED)
 
     # -- internals -----------------------------------------------------
@@ -151,7 +187,16 @@ class Broadcast(ConsensusProtocol):
         ):
             return step.fault(sender, FAULT_INVALID_PROOF)
         self._echo_sent = True
-        step.broadcast(EchoMsg(proof))
+        # Full Echo (with the shard) to everyone still needing shards —
+        # Target.all_except so observers (not in the validator set) keep
+        # receiving shards — and hash-only Echo to peers that declared
+        # CanDecode for this root.
+        hash_only = frozenset(
+            nid for nid, r in self._can_decode.items() if r == proof.root
+        )
+        step.send_targeted(Target.all_except(hash_only), EchoMsg(proof))
+        if hash_only:
+            step.send_targeted(Target.nodes(hash_only), EchoHashMsg(proof.root))
         step.extend(self._handle_echo(self.our_id, proof))
         return step
 
@@ -165,12 +210,59 @@ class Broadcast(ConsensusProtocol):
             return step.fault(sender, FAULT_WRONG_INDEX)
         if not proof.validate(self._netinfo.num_nodes):
             return step.fault(sender, FAULT_INVALID_PROOF)
+        if sender in self._echo_hashes and self._echo_hashes[sender] != proof.root:
+            return step.fault(sender, FAULT_DUPLICATE)
         self._echos[sender] = proof
         n, f = self._netinfo.num_nodes, self._netinfo.num_faulty
-        root_count = sum(1 for p in self._echos.values() if p.root == proof.root)
-        if root_count >= n - f and not self._ready_sent:
+        step.extend(self._maybe_can_decode(proof.root))
+        if self._echo_count(proof.root) >= n - f and not self._ready_sent:
             step.extend(self._send_ready(proof.root))
         return step.extend(self._try_decode())
+
+    def _echo_count(self, root: bytes) -> int:
+        """Distinct senders vouching for ``root`` via Echo or EchoHash."""
+        senders = {s for s, p in self._echos.items() if p.root == root}
+        senders |= {s for s, r in self._echo_hashes.items() if r == root}
+        return len(senders)
+
+    def _handle_echo_hash(self, sender: Any, root: bytes) -> Step:
+        step = Step.empty()
+        if sender in self._echo_hashes or sender in self._echos:
+            prev = self._echo_hashes.get(sender)
+            prev_root = prev if prev is not None else self._echos[sender].root
+            if prev_root != root:
+                step.fault(sender, FAULT_DUPLICATE)
+            return step
+        self._echo_hashes[sender] = root
+        n, f = self._netinfo.num_nodes, self._netinfo.num_faulty
+        if self._echo_count(root) >= n - f and not self._ready_sent:
+            step.extend(self._send_ready(root))
+        return step.extend(self._try_decode())
+
+    def _handle_can_decode(self, sender: Any, root: bytes) -> Step:
+        step = Step.empty()
+        if sender in self._can_decode:
+            if self._can_decode[sender] != root:
+                step.fault(sender, FAULT_DUPLICATE)
+            return step
+        self._can_decode[sender] = root
+        return step
+
+    def _maybe_can_decode(self, root: bytes) -> Step:
+        """Announce CanDecode once K shards for ``root`` are stored.
+
+        Observers follow the protocol silently (they are not in the
+        validator set, so peers would fault their messages)."""
+        step = Step.empty()
+        if self._can_decode_sent or self._terminated:
+            return step
+        if not self._netinfo.is_validator():
+            return step
+        shards = sum(1 for p in self._echos.values() if p.root == root)
+        if shards >= self._data_shards:
+            self._can_decode_sent = True
+            step.broadcast(CanDecodeMsg(root))
+        return step
 
     def _handle_ready(self, sender: Any, root: bytes) -> Step:
         step = Step.empty()

@@ -130,6 +130,10 @@ class Step:
         self.messages.append(TargetedMessage(Target.node(node), message))
         return self
 
+    def send_targeted(self, target: Target, message: Any) -> "Step":
+        self.messages.append(TargetedMessage(target, message))
+        return self
+
     def fault(self, node_id: Any, kind: str) -> "Step":
         self.fault_log.append_fault(node_id, kind)
         return self

@@ -59,3 +59,104 @@ def test_non_proposer_input_ignored():
     net.send_input(1, b"not my turn")
     assert not net.queue
     assert not net.node(1).protocol.terminated
+
+
+def test_echo_hash_counts_toward_ready_threshold():
+    """An EchoHash counts as an Echo for the N-f threshold without
+    carrying a shard (upstream EchoHash optimization)."""
+    from hbbft_tpu.protocols.broadcast import (
+        CanDecodeMsg,
+        EchoHashMsg,
+        EchoMsg,
+        ValueMsg,
+    )
+
+    net = build_net(n=4, seed=21)
+    # Drive node 2 manually (node 3 is crash-faulty): 2 echos + 1 hash.
+    node = net.node(2).protocol
+    import random as _r
+
+    # Build real proofs by running the proposer's input through another net
+    donor = build_net(n=4, seed=21)
+    step = donor.node(0).protocol.handle_input(b"payload", _r.Random(0))
+    proofs = {}
+    for tm in step.messages:
+        msg = tm.message
+        if hasattr(msg, "proof"):
+            for dest in tm.target.recipients(list(range(4)), 0):
+                proofs[dest] = msg.proof
+    proofs[0] = donor.node(0).protocol._echos[0]
+
+    rng = _r.Random(1)
+    node.handle_message(0, ValueMsg(proofs[2]), rng)
+    # node 2 echoed (1); deliver a full echo from 0, hash-echo from 3.
+    node.handle_message(0, EchoMsg(proofs[0]), rng)
+    assert not node._ready_sent
+    node.handle_message(3, EchoHashMsg(proofs[0].root), rng)
+    assert node._ready_sent  # 2 echos + 1 hash = N - f = 3
+    assert 3 in node._echo_hashes
+
+
+def test_can_decode_switches_to_hash_echo():
+    """A peer that declared CanDecode receives EchoHash instead of a full
+    Echo when we later send our Echo."""
+    from hbbft_tpu.protocols.broadcast import CanDecodeMsg, EchoHashMsg, EchoMsg, ValueMsg
+
+    import random as _r
+
+    donor = build_net(n=4, seed=22)
+    step = donor.node(0).protocol.handle_input(b"payload2", _r.Random(0))
+    proofs = {}
+    for tm in step.messages:
+        for dest in tm.target.recipients(list(range(4)), 0):
+            proofs[dest] = tm.message.proof
+
+    net = build_net(n=4, seed=22)
+    node = net.node(2).protocol
+    rng = _r.Random(2)
+    # Peer 1 declares CanDecode before our Value arrives.
+    node.handle_message(1, CanDecodeMsg(proofs[2].root), rng)
+    s = node.handle_message(0, ValueMsg(proofs[2]), rng)
+    sent = {(tm.target, type(tm.message)) for tm in s.messages}
+    # Full echo to 0 and 2; hash-only to 1.
+    by_dest = {}
+    for tm in s.messages:
+        for dest in tm.target.recipients(list(range(4)), 2):
+            by_dest.setdefault(dest, []).append(type(tm.message).__name__)
+    assert "EchoHashMsg" in by_dest[1] and "EchoMsg" not in by_dest[1]
+    assert "EchoMsg" in by_dest[0] and "EchoMsg" in by_dest[3]
+
+
+def test_can_decode_announced_at_k_shards():
+    """A node broadcasts CanDecode once it holds K shards."""
+    from hbbft_tpu.protocols.broadcast import CanDecodeMsg, EchoMsg, ValueMsg
+
+    import random as _r
+
+    donor = build_net(n=4, seed=23)
+    step = donor.node(0).protocol.handle_input(b"payload3", _r.Random(0))
+    proofs = {}
+    for tm in step.messages:
+        for dest in tm.target.recipients(list(range(4)), 0):
+            proofs[dest] = tm.message.proof
+    proofs[0] = donor.node(0).protocol._echos[0]
+
+    net = build_net(n=4, seed=23)
+    node = net.node(2).protocol
+    rng = _r.Random(3)
+    node.handle_message(0, ValueMsg(proofs[2]), rng)  # our echo = 1 shard
+    s = node.handle_message(0, EchoMsg(proofs[0]), rng)  # K=2 shards now
+    assert any(isinstance(tm.message, CanDecodeMsg) for tm in s.messages)
+    # only announced once
+    s2 = node.handle_message(1, EchoMsg(proofs[1]), rng)
+    assert not any(isinstance(tm.message, CanDecodeMsg) for tm in s2.messages)
+
+
+def test_full_run_with_optimization_messages_no_faults():
+    for seed in (31, 32):
+        net = build_net(n=7, seed=seed, adversary=ReorderingAdversary())
+        net.send_input(0, PAYLOAD)
+        net.run_to_termination()
+        for nid in net.correct_ids:
+            assert net.node(nid).outputs == [PAYLOAD]
+        assert net.correct_faults() == []
