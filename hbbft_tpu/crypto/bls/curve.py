@@ -205,6 +205,29 @@ def g2_on_curve(x: F.Fq2E, y: F.Fq2E) -> bool:
     return F.fq2_eq(F.fq2_sqr(y), rhs)
 
 
+def g1_on_curve_jac(jac: Jac) -> bool:
+    """On-curve in Jacobian form: Y^2 == X^3 + b*Z^6 — no inversion.
+
+    (Affine x = X/Z^2, y = Y/Z^3; multiply the affine equation by Z^6.)
+    Identity (Z == 0) counts as on-curve.
+    """
+    x, y, z = jac
+    if z % P == 0:
+        return True
+    z2 = z * z % P
+    return (y * y - (x * x % P * x + B1 * pow(z2, 3, P))) % P == 0
+
+
+def g2_on_curve_jac(jac: Jac) -> bool:
+    x, y, z = jac
+    if F.fq2_is_zero(z):
+        return True
+    z2 = F.fq2_sqr(z)
+    z6 = F.fq2_mul(F.fq2_sqr(z2), z2)
+    rhs = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), F.fq2_mul(B2, z6))
+    return F.fq2_eq(F.fq2_sqr(y), rhs)
+
+
 def _isqrt_exact(n: int) -> Optional[int]:
     if n < 0:
         return None

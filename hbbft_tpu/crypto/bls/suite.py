@@ -141,12 +141,15 @@ class BLSSuite(Suite):
         verification (a torsion component could otherwise cancel with
         noticeable probability).  Cost (one scalar mult) is acceptable in
         this oracle backend; the TPU backend batches the same check.
+        The on-curve test runs in Jacobian form (no inversion — the
+        affine conversion's ``pow`` dominated structural validation at
+        flush batch sizes).
         """
         return (
             isinstance(obj, G1Elem)
             and _coords_valid(obj.jac, fq2=False)
             and _on_curve_and_torsion(
-                C.FQ_OPS, obj.jac, C.g1_on_curve, check_subgroup
+                C.FQ_OPS, obj.jac, C.g1_on_curve_jac, check_subgroup
             )
         )
 
@@ -155,7 +158,7 @@ class BLSSuite(Suite):
             isinstance(obj, G2Elem)
             and _coords_valid(obj.jac, fq2=True)
             and _on_curve_and_torsion(
-                C.FQ2_OPS, obj.jac, C.g2_on_curve, check_subgroup
+                C.FQ2_OPS, obj.jac, C.g2_on_curve_jac, check_subgroup
             )
         )
 
@@ -232,12 +235,11 @@ def _coords_valid(jac: Any, fq2: bool) -> bool:
 
 
 def _on_curve_and_torsion(
-    ops: C.FieldOps, jac: C.Jac, on_curve, check_subgroup: bool
+    ops: C.FieldOps, jac: C.Jac, on_curve_jac, check_subgroup: bool
 ) -> bool:
     if C.jac_is_identity(ops, jac):
         return True
-    aff = C.jac_to_affine(ops, jac)
-    if aff is None or not on_curve(aff[0], aff[1]):
+    if not on_curve_jac(jac):
         return False
     if not check_subgroup:
         return True
