@@ -1,0 +1,78 @@
+"""BASELINE config 1: ThresholdSign 4-of-7, single message.
+
+Metrics: share-verifies/sec (the suite's pairing-check rate) and
+sign-to-combine latency over the virtual network with real BLS crypto.
+Prints one JSON line.  Reference analog: upstream per-share verification
+inside ``src/threshold_sign.rs`` (no published numbers; BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import random
+
+from hbbft_tpu.crypto.backend import EagerBackend, VerifyRequest
+from hbbft_tpu.crypto.bls.suite import BLSSuite
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+
+def main() -> None:
+    suite = BLSSuite()
+    rng = random.Random(1)
+    # Share-verify rate: eager (per-pairing) path, the reference's model.
+    sks = SecretKeySet.random(3, rng, suite)
+    pks = sks.public_keys()
+    msg = b"config1 document"
+    n_checks = int(os.environ.get("BENCH_CHECKS", "24"))
+    reqs = [
+        VerifyRequest.sig_share(
+            pks.public_key_share(i % 7), msg, sks.secret_key_share(i % 7).sign(msg)
+        )
+        for i in range(n_checks)
+    ]
+    eager = EagerBackend(suite)
+    t0 = time.perf_counter()
+    assert all(eager.verify_batch(reqs))
+    dt = time.perf_counter() - t0
+    verifies_per_sec = n_checks / dt
+
+    # Sign-to-combine latency: 7-node net, threshold 3 (4-of-7).
+    t0 = time.perf_counter()
+    net = (
+        NetBuilder(7, seed=2)
+        .suite(suite)
+        .backend(EagerBackend)
+        .protocol(lambda ni, sink, rng_: ThresholdSign(ni, msg, sink))
+        .build()
+    )
+    setup_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    latency_s = time.perf_counter() - t0
+    sig = net.node(0).outputs[0]
+    assert net.node(0).netinfo.public_key_set.verify_signature(msg, sig)
+
+    print(
+        json.dumps(
+            {
+                "config": "threshold_sign_4of7",
+                "share_verifies_per_sec": round(verifies_per_sec, 2),
+                "sign_to_combine_latency_s": round(latency_s, 4),
+                "keygen_setup_s": round(setup_s, 3),
+                "backend": "eager (per-pairing, reference-equivalent)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

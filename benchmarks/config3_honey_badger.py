@@ -1,0 +1,73 @@
+"""BASELINE config 3: HoneyBadger 16-node network sim, 256-tx batches.
+
+Uses the virtual-time simulation harness (examples/simulation.py) so the
+numbers include the hardware-quality network model like the reference's
+``examples/simulation.rs``.  Prints one JSON line.
+
+Suite defaults to the insecure scalar suite (protocol-plane timing, like
+running the reference with crypto hypothetically free); set
+``BENCH_SUITE=bls`` for real threshold crypto (+``BENCH_BACKEND=tpu``
+for the accelerated batch path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.simulation import build_network
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+
+def main() -> None:
+    args = argparse.Namespace(
+        nodes=int(os.environ.get("BENCH_NODES", "16")),
+        txns=int(os.environ.get("BENCH_TXNS", "256")),
+        txn_size=16,
+        batch_size=int(os.environ.get("BENCH_BATCH", "256")),
+        lag_ms=100.0,
+        bw_kbps=2000.0,
+        cpu_factor=1.0,
+        seed=0,
+        suite=os.environ.get("BENCH_SUITE", "scalar"),
+        backend=os.environ.get("BENCH_BACKEND", "batched"),
+        flush_every=int(os.environ.get("BENCH_FLUSH", "1")),
+    )
+    import random
+
+    net = build_network(args)
+    rng = random.Random(7)
+    txns = [rng.randbytes(args.txn_size) for _ in range(args.txns)]
+    t0 = time.perf_counter()
+    for i, txn in enumerate(txns):
+        net.input(i % args.nodes, Input.user(txn))
+    want = set(txns)
+    net.run(lambda n: all(want <= set(node.committed) for node in n.nodes.values()))
+    wall = time.perf_counter() - t0
+
+    nodes = list(net.nodes.values())
+    sim_end = max(max(n.epoch_done_at.values(), default=0.0) for n in nodes)
+    epochs = len(set().union(*[set(n.epoch_done_at) for n in nodes]))
+    print(
+        json.dumps(
+            {
+                "config": "honey_badger_16node_256tx",
+                "nodes": args.nodes,
+                "suite": args.suite,
+                "epochs": epochs,
+                "sim_epoch_latency_s": round(sim_end / max(epochs, 1), 4),
+                "sim_tx_per_s": round(args.txns / sim_end, 2) if sim_end else None,
+                "wall_s": round(wall, 2),
+                "msgs": sum(n.sent_msgs for n in nodes),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""BASELINE config 4: DynamicHoneyBadger 64-node with validator churn.
+
+Runs a 64-node virtual net of QueueingHoneyBadger (DynamicHoneyBadger +
+transaction queue — the queue re-proposes every epoch, which is what
+keeps Subset fed while the embedded SyncKeyGen's Part/Ack messages ride
+through consensus), commits a plain epoch, votes a validator out, and
+measures wall time to the completed era change.  Scalar suite — this
+measures the protocol/DKG control plane, the part that is CPU-bound
+regardless of crypto backend.  One JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+
+
+def batches_of(net, nid):
+    return [o for o in net.node(nid).outputs if isinstance(o, DhbBatch)]
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_NODES", "64"))
+    t0 = time.perf_counter()
+    net = (
+        NetBuilder(n, seed=4)
+        .num_faulty(0)
+        .max_cranks(100_000_000)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni, sink, batch_size=n, session_id=b"cfg4"
+            )
+        )
+        .build()
+    )
+    setup_s = time.perf_counter() - t0
+
+    # Phase 1: a plain epoch.
+    t0 = time.perf_counter()
+    for nid in net.correct_ids:
+        net.send_input(nid, Input.user(f"pre-{nid}"))
+    net.crank_until(
+        lambda net_: all(batches_of(net_, i) for i in net_.correct_ids),
+        max_cranks=50_000_000,
+    )
+    epoch_s = time.perf_counter() - t0
+    epochs_before = max(len(batches_of(net, i)) for i in net.correct_ids)
+
+    # Phase 2: vote a validator out -> era change (DKG among the rest).
+    victim = n - 1
+    ni = net.node(0).protocol.netinfo
+    new_map = {i: ni.public_key(i) for i in ni.all_ids if i != victim}
+    t0 = time.perf_counter()
+    for nid in net.correct_ids:
+        net.send_input(nid, Input.change(Change.node_change(new_map)))
+        net.send_input(nid, Input.user(f"churn-{nid}"))
+    net.crank_until(
+        lambda net_: all(
+            any(b.change.kind == "complete" for b in batches_of(net_, i))
+            for i in net_.correct_ids
+        ),
+        max_cranks=50_000_000,
+    )
+    churn_s = time.perf_counter() - t0
+    epochs_after = max(len(batches_of(net, i)) for i in net.correct_ids)
+    assert not net.node(victim).protocol.netinfo.is_validator()
+
+    print(
+        json.dumps(
+            {
+                "config": "dynamic_hb_64node_churn",
+                "nodes": n,
+                "keygen_setup_s": round(setup_s, 2),
+                "plain_epoch_wall_s": round(epoch_s, 2),
+                "era_change_wall_s": round(churn_s, 2),
+                "epochs_to_complete_change": epochs_after - epochs_before,
+                "delivered_msgs": net.delivered,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

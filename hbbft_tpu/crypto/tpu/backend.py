@@ -19,6 +19,13 @@ the heavy group algebra runs on the accelerator in ONE jitted kernel:
 Kernel shapes are bucketed to powers of two so recompilation is bounded;
 compiled kernels are cached per (n_g1, n_g2, n_legs) bucket.
 
+Multi-chip: with ``shard=True`` (or ``HBBFT_TPU_SHARD=1``) and more than
+one visible device, the batch axis is laid out over a data-parallel
+``jax.sharding.Mesh`` — the scalar-mul scans run fully parallel per
+shard and XLA inserts the collectives for the tree reductions (SURVEY.md
+§2 parallelism note: batching over the share dimension IS this
+framework's parallelism axis).
+
 Replaces the per-share CPU pairing checks of upstream
 ``threshold_crypto`` (``src/lib.rs`` verify paths; SURVEY.md §2 #14).
 """
@@ -115,12 +122,36 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
     return jax.jit(run)
 
 
-class TpuBackend(CryptoBackend):
-    """RLC batch verification with the group algebra on the accelerator."""
+def _shard_mesh(n_devices_wanted: int = 0):
+    """Data-parallel mesh over the largest power-of-two device prefix."""
+    from jax.sharding import Mesh
 
-    def __init__(self, suite: BLSSuite | None = None) -> None:
+    devs = jax.devices()
+    n = 1
+    while n * 2 <= len(devs) and (not n_devices_wanted or n * 2 <= n_devices_wanted):
+        n *= 2
+    if n == 1:
+        return None
+    return Mesh(np.array(devs[:n]).reshape(n), axis_names=("dp",))
+
+
+class TpuBackend(CryptoBackend):
+    """RLC batch verification with the group algebra on the accelerator.
+
+    ``shard=True`` (or env ``HBBFT_TPU_SHARD=1``) lays the batch axis
+    over all visible devices data-parallel; default is single-device.
+    """
+
+    def __init__(
+        self, suite: BLSSuite | None = None, shard: bool | None = None
+    ) -> None:
+        import os
+
         self.suite = suite or BLSSuite()
         self._eager = EagerBackend(self.suite)
+        if shard is None:
+            shard = os.environ.get("HBBFT_TPU_SHARD") == "1"
+        self._mesh = _shard_mesh() if shard else None
 
     # -- leg construction (host, cheap): mirrors backend._rlc_pairs ----
 
@@ -204,9 +235,31 @@ class TpuBackend(CryptoBackend):
         rhs_pts = dcurve.g2_to_dev(rhs + [ident2] * (nl - len(rhs)))
         gen_pt = dcurve.g1_to_dev([ocurve.G1_GEN])
         gen_pt = tuple(x[0] for x in gen_pt)
+        g1_chk = jnp.asarray(g1_chk)
+        seg = jnp.asarray(seg)
+        g2_chk = jnp.asarray(g2_chk)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            batch = NamedSharding(self._mesh, PS("dp"))
+            seg_sh = NamedSharding(self._mesh, PS(None, "dp"))
+            repl = NamedSharding(self._mesh, PS())
+
+            def put(x, sh):
+                return jax.device_put(x, sh)
+
+            g1_pts = tuple(put(c, batch) for c in g1_pts)
+            g2_pts = tuple(put(c, batch) for c in g2_pts)
+            g1_bits = put(g1_bits, batch)
+            g2_bits = put(g2_bits, batch)
+            g1_chk = put(g1_chk, batch)
+            g2_chk = put(g2_chk, batch)
+            seg = put(seg, seg_sh)
+            rhs_pts = tuple(put(c, repl) for c in rhs_pts)
+            gen_pt = tuple(put(c, repl) for c in gen_pt)
         ok = _kernel(n1, n2, nl)(
-            g1_pts, g1_bits, jnp.asarray(g1_chk), jnp.asarray(seg),
-            g2_pts, g2_bits, jnp.asarray(g2_chk), rhs_pts, gen_pt
+            g1_pts, g1_bits, g1_chk, seg,
+            g2_pts, g2_bits, g2_chk, rhs_pts, gen_pt
         )
         return bool(ok)
 

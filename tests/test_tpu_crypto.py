@@ -302,3 +302,30 @@ def test_device_subgroup_check_and_rejection():
     )
     got = TpuBackend(suite).verify_batch(reqs)
     assert got == [True, True, True, False]
+
+
+def test_tpu_backend_sharded_flush_matches():
+    """shard=True lays the verify batch over the virtual 8-device CPU
+    mesh (conftest); results must match the single-device path."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device platform")
+    suite = BLSSuite()
+    rng_ = random.Random(31)
+    sks = SecretKeySet.random(2, rng_, suite)
+    pks = sks.public_keys()
+    msg = b"sharded flush doc"
+    reqs = [
+        VerifyRequest.sig_share(
+            pks.public_key_share(i % 8), msg, sks.secret_key_share(i % 8).sign(msg)
+        )
+        for i in range(16)
+    ]
+    reqs[5] = VerifyRequest.sig_share(
+        pks.public_key_share(5), msg, sks.secret_key_share(4).sign(msg)
+    )  # bad share
+    sharded = TpuBackend(suite, shard=True)
+    assert sharded._mesh is not None
+    got = sharded.verify_batch(reqs)
+    want = [True] * 16
+    want[5] = False
+    assert got == want
