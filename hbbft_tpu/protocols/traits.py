@@ -33,6 +33,8 @@ class Target:
     """Message routing directive without a transport.
 
     Reference: upstream ``Target::{All, AllExcept(set), Nodes(set)}``.
+    (No ``slots=True`` here: the ``nodes`` field's slot descriptor would
+    shadow the ``nodes()`` constructor.)
     """
 
     kind: str  # "all" | "all_except" | "nodes"
@@ -44,7 +46,7 @@ class Target:
 
     @staticmethod
     def all() -> "Target":
-        return Target(Target.ALL)
+        return _TARGET_ALL
 
     @staticmethod
     def all_except(nodes: Iterable[Any]) -> "Target":
@@ -67,7 +69,10 @@ class Target:
         return [n for n in self.nodes if n != our_id]
 
 
-@dataclass(frozen=True)
+_TARGET_ALL = Target(Target.ALL)
+
+
+@dataclass(frozen=True, slots=True)
 class TargetedMessage:
     """An outgoing message with its routing directive."""
 
@@ -75,7 +80,7 @@ class TargetedMessage:
     message: Any
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class SourcedMessage:
     """An incoming message tagged with its sender."""
 
@@ -83,7 +88,7 @@ class SourcedMessage:
     message: Any
 
 
-@dataclass
+@dataclass(slots=True)
 class Step:
     """The sole side-effect channel of every protocol handler.
 
@@ -100,9 +105,12 @@ class Step:
 
     def extend(self, other: "Step") -> "Step":
         """Merge ``other`` into self (in place), returning self."""
-        self.output.extend(other.output)
-        self.messages.extend(other.messages)
-        self.fault_log.extend(other.fault_log)
+        if other.output:
+            self.output.extend(other.output)
+        if other.messages:
+            self.messages.extend(other.messages)
+        if other.fault_log.faults:
+            self.fault_log.extend(other.fault_log)
         return self
 
     def with_output(self, out: Any) -> "Step":
@@ -114,13 +122,16 @@ class Step:
 
         This is how parent protocols lift child messages into their own
         message type (reference: ``Step::map`` in upstream ``src/traits.rs``).
-        Output and fault log are carried through unchanged.
+        Output and fault log are carried through unchanged.  Wrapping is
+        done IN PLACE on this step's message list (handlers always merge
+        the result into a fresh parent step, so the child step is never
+        reused) — the per-message Step/list allocations of a copying map
+        dominated the control-plane profile at N=64.
         """
-        return Step(
-            output=list(self.output),
-            messages=[TargetedMessage(m.target, wrap(m.message)) for m in self.messages],
-            fault_log=FaultLog(list(self.fault_log.faults)),
-        )
+        msgs = self.messages
+        for i, m in enumerate(msgs):
+            msgs[i] = TargetedMessage(m.target, wrap(m.message))
+        return self
 
     def broadcast(self, message: Any) -> "Step":
         self.messages.append(TargetedMessage(Target.all(), message))
