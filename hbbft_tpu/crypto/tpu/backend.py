@@ -122,13 +122,19 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
     return jax.jit(run)
 
 
-def _shard_mesh(n_devices_wanted: int = 0):
-    """Data-parallel mesh over the largest power-of-two device prefix."""
+def _shard_mesh(max_devices: int = 16):
+    """Data-parallel mesh over the largest power-of-two device prefix.
+
+    Capped at the kernel's minimum batch bucket (floor 16 in ``_bucket``)
+    so the batch axis is always divisible by the mesh — a 32-way mesh
+    over a 16-row bucket would make ``device_put`` raise on every small
+    flush.
+    """
     from jax.sharding import Mesh
 
     devs = jax.devices()
     n = 1
-    while n * 2 <= len(devs) and (not n_devices_wanted or n * 2 <= n_devices_wanted):
+    while n * 2 <= min(len(devs), max_devices):
         n *= 2
     if n == 1:
         return None

@@ -106,6 +106,9 @@ def ints_to_limbs_batch(vals) -> np.ndarray:
     """
     if not vals:
         return np.zeros((0, NL), dtype=np.int32)
+    limit = 1 << (B * NL)
+    for v in vals:
+        assert 0 <= v < limit, "value does not fit"
     data = np.frombuffer(
         b"".join(v.to_bytes(50, "little") for v in vals), dtype=np.uint8
     ).reshape(len(vals), 50)

@@ -118,15 +118,15 @@ class Step:
         return self
 
     def map_messages(self, wrap: Callable[[Any], Any]) -> "Step":
-        """Return a new Step with every message payload wrapped.
+        """Wrap every message payload IN PLACE and return self.
 
         This is how parent protocols lift child messages into their own
         message type (reference: ``Step::map`` in upstream ``src/traits.rs``).
-        Output and fault log are carried through unchanged.  Wrapping is
-        done IN PLACE on this step's message list (handlers always merge
-        the result into a fresh parent step, so the child step is never
-        reused) — the per-message Step/list allocations of a copying map
-        dominated the control-plane profile at N=64.
+        Output and fault log are carried through unchanged.  The caller
+        must not reuse the un-wrapped step afterwards — every handler
+        merges the result into a fresh parent step, and the copying
+        version's per-message allocations dominated the control-plane
+        profile at N=64.
         """
         msgs = self.messages
         for i, m in enumerate(msgs):

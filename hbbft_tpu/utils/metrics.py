@@ -70,10 +70,10 @@ class Metrics:
         """JAX profiler capture (TensorBoard format); no-op without jax."""
         try:
             import jax
-
-            with jax.profiler.trace(logdir):
-                yield
         except ImportError:  # pragma: no cover
+            yield
+            return
+        with jax.profiler.trace(logdir):
             yield
 
     def merge(self, other: "Metrics") -> None:
