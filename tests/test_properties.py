@@ -193,3 +193,49 @@ def test_determinism_same_seed_same_transcript():
     assert a1 == a2
     # Different seed takes a different path (delivery order differs).
     assert a1[1] != b[1] or a1[0] == b[0]
+
+
+@settings(max_examples=6, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(seed=seeds, n=st.integers(min_value=4, max_value=7))
+def test_queueing_honey_badger_exactly_once(seed, n):
+    """Every pushed transaction commits exactly once on every node."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+    from hbbft_tpu.protocols.queueing_honey_badger import (
+        Input,
+        QueueingHoneyBadger,
+    )
+
+    net = (
+        NetBuilder(n, seed=seed)
+        .adversary(ReorderingAdversary())
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni, sink, batch_size=2 * n, session_id=b"prop-qhb"
+            )
+        )
+        .build()
+    )
+    txns = [f"tx-{nid}-{k}" for nid in net.correct_ids for k in range(2)]
+    for nid in net.correct_ids:
+        for k in range(2):
+            net.send_input(nid, Input.user(f"tx-{nid}-{k}"))
+
+    def committed(net_, nid):
+        out = []
+        for o in net_.node(nid).outputs:
+            if isinstance(o, DhbBatch):
+                for _, c in o.contributions:
+                    out.extend(c)
+        return out
+
+    net.crank_until(
+        lambda net_: all(
+            set(txns) <= set(committed(net_, i)) for i in net_.correct_ids
+        ),
+        max_cranks=3_000_000,
+    )
+    for nid in net.correct_ids:
+        got = committed(net, nid)
+        assert len(got) == len(set(got)), "a transaction committed twice"
+    assert net.correct_faults() == []

@@ -83,3 +83,28 @@ def test_random_adversary_replay_does_not_break(monkeypatch):
     net.run_to_termination()
     for nid in net.correct_ids:
         assert len(net.node(nid).outputs) == 1
+
+
+def test_coin_fairness_statistics():
+    """Upstream threshold_sign tests include coin-fairness statistics:
+    the combined signature's parity over many distinct round nonces must
+    be roughly balanced (it seeds the ABA common coin)."""
+    import random
+
+    from hbbft_tpu.crypto.keys import SecretKeySet
+    from hbbft_tpu.crypto.suite import ScalarSuite
+
+    suite = ScalarSuite()
+    rng = random.Random(99)
+    sks = SecretKeySet.random(2, rng, suite)
+    pks = sks.public_keys()
+    trials = 400
+    ones = 0
+    for r in range(trials):
+        doc = b"coin-%d" % r
+        shares = {i: sks.secret_key_share(i).sign(doc) for i in range(3)}
+        sig = pks.combine_signatures(shares)
+        assert pks.verify_signature(doc, sig)
+        ones += int(sig.parity())
+    # 400 fair flips: P(|ones-200| > 60) < 1e-8.
+    assert abs(ones - trials / 2) <= 60, f"biased coin: {ones}/{trials}"
