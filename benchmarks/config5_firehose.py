@@ -1,0 +1,78 @@
+"""BASELINE config 5: the 10k-validator BLS share-verify firehose.
+
+The north-star scale (BASELINE.json:11): accumulate an epoch's worth of
+signature shares at 10k-validator scale and verify them as one batched
+flush on the accelerator.  Prints one JSON line.
+
+On a machine without the TPU this still runs (CPU XLA) but the number is
+meaningless; the driver's ``bench.py`` run on real hardware is the
+recorded headline.  ``BENCH_SHARES`` scales the batch (default 10240 ~
+"10k validators' coin shares in one epoch").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.utils.jaxcache import enable_cache
+
+enable_cache()
+
+import random
+
+from hbbft_tpu.crypto.backend import VerifyRequest
+from hbbft_tpu.crypto.bls.suite import BLSSuite
+from hbbft_tpu.crypto.keys import SecretKeySet
+from hbbft_tpu.crypto.tpu.backend import TpuBackend
+
+
+def main() -> None:
+    n_shares = int(os.environ.get("BENCH_SHARES", "10240"))
+    suite = BLSSuite()
+    rng = random.Random(13)
+    # Key material for a handful of signer indices; the batch reuses
+    # them round-robin (verification cost is per share, not per signer).
+    sks = SecretKeySet.random(3, rng, suite)
+    pks = sks.public_keys()
+    msg = b"firehose epoch document"
+    shares = [sks.secret_key_share(i % 10).sign(msg) for i in range(10)]
+    reqs = [
+        VerifyRequest.sig_share(pks.public_key_share(i % 10), msg, shares[i % 10])
+        for i in range(n_shares)
+    ]
+
+    backend = TpuBackend(suite)
+    t0 = time.perf_counter()
+    warm = backend.verify_batch(reqs)
+    compile_s = time.perf_counter() - t0
+    assert all(warm)
+
+    t0 = time.perf_counter()
+    res = backend.verify_batch(reqs)
+    dt = time.perf_counter() - t0
+    assert all(res)
+
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "config": "firehose_10k_share_verify",
+                "shares": n_shares,
+                "verifies_per_sec": round(n_shares / dt, 1),
+                "flush_latency_s": round(dt, 4),
+                "north_star_under_50ms": dt < 0.05,
+                "first_call_s": round(compile_s, 1),
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
