@@ -98,6 +98,8 @@ class TimedNetwork:
 
     def __init__(self, nodes: Dict[Any, SimNode], backend, hw: HwQuality,
                  flush_every: int = 1) -> None:
+        from hbbft_tpu.utils.metrics import Metrics
+
         self.nodes = nodes
         self.backend = backend
         self.hw = hw
@@ -106,6 +108,7 @@ class TimedNetwork:
         self._seq = 0
         self.delivered = 0
         self._since_flush: Dict[Any, int] = {nid: 0 for nid in nodes}
+        self.metrics = Metrics()
 
     def _push(self, at: float, dest: Any, sender: Any, payload: Any) -> None:
         self._seq += 1
@@ -142,7 +145,9 @@ class TimedNetwork:
             return
         self._since_flush[node.id] = 0
         while node.pool:
-            step = self._timed(node, node.pool.flush, self.backend)
+            self.metrics.count("verify_requests", len(node.pool))
+            with self.metrics.timer("verify_flush"):
+                step = self._timed(node, node.pool.flush, self.backend)
             self._emit(node, step)
 
     def input(self, nid: Any, value: Any) -> None:
@@ -285,6 +290,7 @@ def main() -> None:
           f"({args.txns / sim_end if sim_end else 0:.1f} tx/s); "
           f"{msgs} msgs, {mbytes:.2f} MB on the wire; "
           f"crypto+protocol CPU {cpu:.2f}s; wall {wall:.2f}s")
+    print("\n" + net.metrics.report())
 
 
 if __name__ == "__main__":
