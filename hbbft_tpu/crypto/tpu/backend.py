@@ -75,7 +75,8 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
     """Compiled flush kernel for one shape bucket.
 
     Inputs (all device arrays):
-      g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, NBITS),
+      g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, RM1_NBITS;
+      the 128-bit RLC coefficient zero-padded to the torsion width),
       g1 subgroup-check mask (n_g1,), g1 leg one-hot (n_legs, n_g1);
       g2 pts / bits / mask (n_g2 …) — the generator leg;
       rhs G2 points (n_legs) to pair each G1 leg sum with.
