@@ -128,15 +128,26 @@ class Broadcast(ConsensusProtocol):
 
     # -- input (proposer only) ----------------------------------------
     def handle_input(self, input: bytes, rng: Any) -> Step:
+        if self.our_id != self._proposer or self._had_input:
+            return Step.empty()
+        shards = self._rs.encode(list(_pack(bytes(input), self._data_shards)))
+        tree = MerkleTree(shards)
+        return self.propose_with_proofs([tree.proof(i) for i in range(self._netinfo.num_nodes)])
+
+    def propose_with_proofs(self, proofs) -> Step:
+        """Proposer fast path: disperse PRECOMPUTED shard proofs.
+
+        ``proofs[i]`` is shard i's proof (index order).  Used by
+        :func:`batch_propose` to feed device-computed (batched RS +
+        Merkle) proofs into many instances without redoing the data
+        plane per instance; ``handle_input`` routes through here too.
+        """
         step = Step.empty()
         if self.our_id != self._proposer or self._had_input:
             return step
         self._had_input = True
-        shards = self._rs.encode(list(_pack(bytes(input), self._data_shards)))
-        tree = MerkleTree(shards)
-        our_index = self._netinfo.our_index
         for nid in self._netinfo.all_ids:
-            proof = tree.proof(self._netinfo.index(nid))
+            proof = proofs[self._netinfo.index(nid)]
             if nid == self.our_id:
                 step.extend(self._handle_value(self.our_id, proof))
             else:
@@ -327,3 +338,48 @@ class Broadcast(ConsensusProtocol):
             self._terminated = True
             return step.with_output(value)
         return step
+
+
+def batch_propose(broadcasts, values):
+    """Propose many values across many Broadcast instances at once.
+
+    Computes every instance's RS shards + Merkle proofs with the batched
+    device data plane (:mod:`hbbft_tpu.ops.jaxops.dataplane`) when shard
+    sizes allow — one bit-matmul and a handful of Keccak calls for the
+    whole batch — and falls back to the per-instance host path
+    otherwise.  Returns one Step per instance (same semantics as calling
+    ``handle_input`` on each).
+
+    At firehose scale a proposer participates in many concurrent
+    sessions/epochs; this is the aggregation point that turns N
+    independent O(|v|) data-plane jobs into one device batch.
+    """
+    from collections import defaultdict
+
+    assert len(broadcasts) == len(values)
+    steps: dict = {}
+    groups = defaultdict(list)
+    for idx, (bc, value) in enumerate(zip(broadcasts, values)):
+        k, n = bc._data_shards, bc._netinfo.num_nodes
+        _, shard_len = _dataplane()._pack(bytes(value), k)
+        if shard_len <= _dataplane().MAX_DEV_SHARD:
+            groups[(k, n, shard_len)].append(idx)
+        else:
+            steps[idx] = bc.handle_input(bytes(value), None)
+    for (k, n, _), idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            steps[i] = broadcasts[i].handle_input(bytes(values[i]), None)
+            continue
+        proofs = _dataplane().encode_and_prove(
+            [bytes(values[i]) for i in idxs], k, n
+        )
+        for j, i in enumerate(idxs):
+            steps[i] = broadcasts[i].propose_with_proofs(proofs[j])
+    return [steps[i] for i in range(len(broadcasts))]
+
+
+def _dataplane():
+    from hbbft_tpu.ops.jaxops import dataplane
+
+    return dataplane

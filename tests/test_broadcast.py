@@ -160,3 +160,33 @@ def test_full_run_with_optimization_messages_no_faults():
         for nid in net.correct_ids:
             assert net.node(nid).outputs == [PAYLOAD]
         assert net.correct_faults() == []
+
+
+def test_batch_propose_matches_individual():
+    """batch_propose (device data plane) == per-instance handle_input."""
+    import random as _r
+
+    from hbbft_tpu.protocols.broadcast import batch_propose
+
+    payloads = [(_r.Random(i).randbytes(300)) for i in range(4)]
+    # Separate nets: each a fresh Broadcast with proposer 0.
+    nets_a = [build_net(n=7, seed=40 + i) for i in range(4)]
+    nets_b = [build_net(n=7, seed=40 + i) for i in range(4)]
+
+    steps = batch_propose([net.node(0).protocol for net in nets_a], payloads)
+    for net, step in zip(nets_a, steps):
+        net._process_step(net.node(0), step)
+        net.run_to_termination()
+    for net, payload in zip(nets_a, payloads):
+        for nid in net.correct_ids:
+            assert net.node(nid).outputs == [payload]
+        assert net.correct_faults() == []
+
+    # Identical message payloads (proofs) as the host path.
+    for net, payload in zip(nets_b, payloads):
+        net.send_input(0, payload)
+        net.run_to_termination()
+    for na, nb in zip(nets_a, nets_b):
+        assert [n_.outputs for _, n_ in sorted(na.nodes.items())] == [
+            n_.outputs for _, n_ in sorted(nb.nodes.items())
+        ]

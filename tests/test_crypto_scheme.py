@@ -155,3 +155,29 @@ def test_bls_elements_survive_pickling():
         [VerifyRequest.sig_share(pks.public_key_share(0), b"msg", sig2)]
     )
     assert ok == [True]
+
+
+def test_batch_affine_edge_cases():
+    """Montgomery batch inversion: duplicates, identity, cached, garbage."""
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    g = suite.g2_generator()
+    p1 = g * 5
+    p2 = g * 9
+    ident = suite.g2_identity()
+    dup = p1  # same object twice in the list
+    cached = g * 7
+    cached.affine()  # pre-warm
+    garbage = "not a point"
+    suite.batch_affine([p1, dup, ident, cached, garbage, p2])
+    # All finite points now have exact affine forms.
+    for p in (p1, p2, cached):
+        x, y = p.affine()
+        import hbbft_tpu.crypto.bls.curve as oc
+
+        assert oc.g2_on_curve(x, y)
+    assert ident.affine() is None
+    # Values agree with the lazy path.
+    q = suite.g2_generator() * 5
+    assert p1.affine() == q.affine()
