@@ -49,7 +49,7 @@ from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
 from hbbft_tpu.protocols.sender_queue import SenderQueue
 from hbbft_tpu.protocols.traits import Step
-from hbbft_tpu.utils import serde
+from hbbft_tpu.utils import sizeof
 
 
 @dataclass
@@ -124,7 +124,7 @@ class TimedNetwork:
                         node.committed.extend(contrib)
         all_ids = sorted(self.nodes)
         for tm in step.messages:
-            size = len(serde.dumps(tm.message))
+            size = sizeof.estimate(tm.message)
             for dest in tm.target.recipients(all_ids, node.id):
                 node.sent_msgs += 1
                 node.sent_bytes += size

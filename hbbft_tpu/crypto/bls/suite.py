@@ -18,7 +18,7 @@ class _PointElem:
     computed lazily and cached.
     """
 
-    __slots__ = ("jac", "_affine", "_bytes")
+    __slots__ = ("jac", "_affine", "_bytes", "_subgroup_ok")
 
     ops: C.FieldOps  # set on subclasses
     tag: bytes
@@ -27,17 +27,24 @@ class _PointElem:
         self.jac = jac
         self._affine: Any = _UNSET
         self._bytes: Optional[bytes] = None
+        # Memo: r-torsion membership, once proven.  The check costs a
+        # full scalar mult; serde decode, protocol validation, and the
+        # eager backend may each ask — only the first pays.
+        self._subgroup_ok = False
 
     def __getstate__(self):
         # Drop the lazy caches: the _UNSET sentinel does not survive
         # pickling by identity (a round-trip would resurrect it as an
         # arbitrary object that affine() then hands out as coordinates).
+        # _subgroup_ok is also dropped: a pickle round-trip must not
+        # carry a trust assertion.
         return self.jac
 
     def __setstate__(self, state):
         self.jac = state
         self._affine = _UNSET
         self._bytes = None
+        self._subgroup_ok = False
 
     # -- group ops -----------------------------------------------------
     def __add__(self, other: "_PointElem"):
@@ -81,6 +88,8 @@ _UNSET = object()
 class G1Elem(_PointElem):
     ops = C.FQ_OPS
     tag = b"g1"
+    serde_suite_name = "bls12-381"
+    serde_group = 1
 
     def to_bytes(self) -> bytes:
         if self._bytes is None:
@@ -97,6 +106,8 @@ class G1Elem(_PointElem):
 class G2Elem(_PointElem):
     ops = C.FQ2_OPS
     tag = b"g2"
+    serde_suite_name = "bls12-381"
+    serde_group = 2
 
     def to_bytes(self) -> bytes:
         if self._bytes is None:
@@ -149,7 +160,7 @@ class BLSSuite(Suite):
             isinstance(obj, G1Elem)
             and _coords_valid(obj.jac, fq2=False)
             and _on_curve_and_torsion(
-                C.FQ_OPS, obj.jac, C.g1_on_curve_jac, check_subgroup
+                C.FQ_OPS, obj, C.g1_on_curve_jac, check_subgroup
             )
         )
 
@@ -158,9 +169,25 @@ class BLSSuite(Suite):
             isinstance(obj, G2Elem)
             and _coords_valid(obj.jac, fq2=True)
             and _on_curve_and_torsion(
-                C.FQ2_OPS, obj.jac, C.g2_on_curve_jac, check_subgroup
+                C.FQ2_OPS, obj, C.g2_on_curve_jac, check_subgroup
             )
         )
+
+    def g1_from_bytes(self, data: bytes) -> G1Elem:
+        """Decode the 97-byte affine encoding; full membership validation
+        (coordinate range, on-curve, r-torsion) — decoded elements come
+        from committed-but-attacker-authored bytes and go straight into
+        pairing checks, so the wire policy of :meth:`is_g1` applies."""
+        elem = G1Elem(_jac_from_bytes(data, fq2=False))
+        if not self.is_g1(elem):
+            raise ValueError("not a valid G1 element")
+        return elem
+
+    def g2_from_bytes(self, data: bytes) -> G2Elem:
+        elem = G2Elem(_jac_from_bytes(data, fq2=True))
+        if not self.is_g2(elem):
+            raise ValueError("not a valid G2 element")
+        return elem
 
     def hash_to_g2(self, data: bytes) -> G2Elem:
         return G2Elem(C.hash_to_g2(bytes(data)))
@@ -217,6 +244,28 @@ class BLSSuite(Suite):
                 )
 
 
+def _jac_from_bytes(data: Any, fq2: bool) -> C.Jac:
+    """Parse the affine wire encoding produced by ``to_bytes`` into a
+    Jacobian point (z = 1).  Structural checks only — curve/subgroup
+    membership is the caller's job."""
+    coords = 4 if fq2 else 2
+    if not isinstance(data, bytes) or len(data) != 1 + 48 * coords:
+        raise ValueError("bad point encoding length")
+    flag, body = data[0], data[1:]
+    if flag == 0:
+        if any(body):
+            raise ValueError("non-canonical identity encoding")
+        return C.jac_identity(C.FQ2_OPS if fq2 else C.FQ_OPS)
+    if flag != 1:
+        raise ValueError("bad point flag")
+    vals = [int.from_bytes(body[i * 48 : (i + 1) * 48], "big") for i in range(coords)]
+    if any(v >= F.P for v in vals):
+        raise ValueError("coordinate out of field range")
+    if fq2:
+        return ((vals[0], vals[1]), (vals[2], vals[3]), C.FQ2_OPS.one)
+    return (vals[0], vals[1], C.FQ_OPS.one)
+
+
 def _fq_valid(v: Any) -> bool:
     return isinstance(v, int) and not isinstance(v, bool) and 0 <= v < F.P
 
@@ -235,12 +284,16 @@ def _coords_valid(jac: Any, fq2: bool) -> bool:
 
 
 def _on_curve_and_torsion(
-    ops: C.FieldOps, jac: C.Jac, on_curve_jac, check_subgroup: bool
+    ops: C.FieldOps, elem: _PointElem, on_curve_jac, check_subgroup: bool
 ) -> bool:
+    jac = elem.jac
     if C.jac_is_identity(ops, jac):
         return True
     if not on_curve_jac(jac):
         return False
-    if not check_subgroup:
+    if not check_subgroup or elem._subgroup_ok:
         return True
-    return C.jac_is_identity(ops, C.jac_mul(ops, jac, F.R))
+    ok = C.jac_is_identity(ops, C.jac_mul(ops, jac, F.R))
+    if ok:
+        elem._subgroup_ok = True
+    return ok

@@ -81,6 +81,21 @@ class Suite(abc.ABC):
         h = hashlib.sha3_256(b"h2s" + data).digest()
         return int.from_bytes(h, "big") % self.scalar_modulus
 
+    # -- wire decoding ------------------------------------------------
+    @abc.abstractmethod
+    def g1_from_bytes(self, data: bytes) -> Any:
+        """Decode (and fully validate) a wire-sourced G1 element.
+
+        Raises ``ValueError`` on anything that is not the canonical
+        encoding of a subgroup element — this is the codec-side twin of
+        :meth:`is_g1` and MUST enforce the same membership policy,
+        because decoded elements reach pairing checks directly.
+        """
+
+    @abc.abstractmethod
+    def g2_from_bytes(self, data: bytes) -> Any:
+        """Decode (and fully validate) a wire-sourced G2 element."""
+
     # -- pairing ------------------------------------------------------
     @abc.abstractmethod
     def pairing_product_is_one(self, pairs: Sequence[Tuple[Any, Any]]) -> bool:
@@ -97,6 +112,11 @@ class ScalarG:
 
     value: int
     modulus: int
+
+    # serde hooks (no annotation: class attrs, not dataclass fields).
+    # G1 and G2 are the same structure in this suite, so one group id.
+    serde_suite_name = "scalar-insecure"
+    serde_group = 1
 
     def __add__(self, other: "ScalarG") -> "ScalarG":
         return ScalarG((self.value + other.value) % self.modulus, self.modulus)
@@ -153,6 +173,16 @@ class ScalarSuite(Suite):
 
     def is_g2(self, obj: Any, check_subgroup: bool = True) -> bool:
         return self.is_g1(obj)
+
+    def g1_from_bytes(self, data: bytes) -> ScalarG:
+        if not isinstance(data, bytes) or len(data) != 32:
+            raise ValueError("scalar group element: want 32 bytes")
+        v = int.from_bytes(data, "big")
+        if v >= self.scalar_modulus:
+            raise ValueError("scalar group element out of range")
+        return ScalarG(v, self.scalar_modulus)
+
+    g2_from_bytes = g1_from_bytes
 
     def hash_to_g2(self, data: bytes) -> ScalarG:
         h = hashlib.sha3_256(canonical_bytes(b"h2g2", data)).digest()

@@ -37,10 +37,11 @@ from hbbft_tpu.protocols.honey_badger import (
     HbMessage,
     HoneyBadger,
 )
+from hbbft_tpu.protocols.errors import ContributionNotEncodable
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.sync_key_gen import Ack, Part, SyncKeyGen
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
-from hbbft_tpu.utils import canonical_bytes
+from hbbft_tpu.utils import canonical_bytes, serde
 
 FAULT_MALFORMED = "dynamic_honey_badger:malformed-message"
 FAULT_BAD_CONTRIB = "dynamic_honey_badger:malformed-contribution"
@@ -356,8 +357,16 @@ class DynamicHoneyBadger(ConsensusProtocol):
         return self._hb.has_input
 
     def handle_input(self, input: Any, rng: Any) -> Step:
-        """Propose a user contribution this epoch."""
+        """Propose a user contribution this epoch.
+
+        Encodability is validated BEFORE ``_make_contrib`` drains the
+        outgoing key-gen queue, so a bad input cannot destroy queued DKG
+        messages on its way to raising."""
         self._rng = rng
+        try:
+            serde.dumps(input)
+        except serde.EncodeError as e:
+            raise ContributionNotEncodable(str(e)) from e
         return self._lift(self._hb.handle_input(self._make_contrib(input), rng))
 
     def vote_for(self, change: Change, rng: Any) -> Step:

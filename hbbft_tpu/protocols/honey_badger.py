@@ -26,6 +26,7 @@ from hbbft_tpu.crypto.pool import VerifySink
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.subset import Subset, SubsetOutput
 from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt
+from hbbft_tpu.protocols.errors import ContributionNotEncodable
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 from hbbft_tpu.utils import canonical_bytes, serde
 
@@ -160,7 +161,7 @@ class _EpochState:
         step = Step.empty()
         if not self.encrypted:
             return step.extend(self._accept_plaintext(proposer, payload))
-        ct = serde.try_loads(payload)
+        ct = serde.try_loads(payload, suite=self.hb._suite())
         if not isinstance(ct, Ciphertext):
             self.faulty_proposers.add(proposer)
             step.fault(proposer, FAULT_BAD_CIPHERTEXT)
@@ -203,12 +204,13 @@ class _EpochState:
         step = Step.empty()
         if proposer in self.decrypted or proposer in self.faulty_proposers:
             return step
-        contribution = serde.try_loads(data)
-        if contribution is None:
+        # loads (not try_loads): a legitimate None contribution must be
+        # distinguishable from malformed bytes.
+        try:
+            self.decrypted[proposer] = serde.loads(data, suite=self.hb._suite())
+        except serde.DecodeError:
             self.faulty_proposers.add(proposer)
             step.fault(proposer, FAULT_BAD_CONTRIBUTION)
-        else:
-            self.decrypted[proposer] = contribution
         return step.extend(self._try_batch())
 
     # -- message routing ----------------------------------------------
@@ -276,7 +278,12 @@ class HoneyBadger(ConsensusProtocol):
         self._state = _EpochState(self, 0)
         self._future: Dict[int, List[Tuple[Any, HbMessage]]] = {}
         self._future_per_sender: Dict[Any, int] = {}
-        self._pending_proposal: Optional[Any] = None
+        self._pending_proposal: Optional[Tuple[Any, Any, bytes]] = None
+
+    def _suite(self) -> Any:
+        """The network's crypto suite — pins serde decoding so committed
+        bytes cannot select a different (e.g. the insecure test) suite."""
+        return self._netinfo.public_key_set.suite
 
     # -- ConsensusProtocol --------------------------------------------
     @property
@@ -297,23 +304,32 @@ class HoneyBadger(ConsensusProtocol):
         return self._state.proposed
 
     def handle_input(self, input: Any, rng: Any) -> Step:
-        """Propose ``input`` (any serializable contribution) this epoch.
+        """Propose ``input`` (any codec-encodable contribution) this
+        epoch: primitives, containers, and the registered wire types
+        (see :mod:`hbbft_tpu.wire`).  Raises
+        :class:`~hbbft_tpu.protocols.errors.ContributionNotEncodable`
+        for anything else — at the boundary, before any state changes.
 
         A proposal made while the current epoch already has one is held
         and submitted at the next epoch start.
         """
         if not self._netinfo.is_validator():
             return Step.empty()
+        try:
+            data = serde.dumps(input)
+        except serde.EncodeError as e:
+            raise ContributionNotEncodable(str(e)) from e
         if self._state.proposed:
             # Hold (with its rng — the epoch may roll over inside a
             # verify-pool flush, where no caller rng is in scope).
-            self._pending_proposal = (input, rng)
+            self._pending_proposal = (input, rng, data)
             return Step.empty()
-        return self._propose_now(input, rng)
+        return self._propose_now(input, rng, data)
 
-    def _propose_now(self, input: Any, rng: Any) -> Step:
+    def _propose_now(self, input: Any, rng: Any, data: Optional[bytes] = None) -> Step:
         self._state.proposed = True
-        data = serde.dumps(input)
+        if data is None:
+            data = serde.dumps(input)
         if self._state.encrypted:
             pk = self._netinfo.public_key_set.public_key()
             data = serde.dumps(pk.encrypt(data, rng))
@@ -364,8 +380,11 @@ class HoneyBadger(ConsensusProtocol):
             self._epoch += 1
             self._state = _EpochState(self, self._epoch)
             if self._pending_proposal is not None:
-                (proposal, prop_rng), self._pending_proposal = self._pending_proposal, None
-                step.extend(self._propose_now(proposal, prop_rng))
+                (proposal, prop_rng, data), self._pending_proposal = (
+                    self._pending_proposal,
+                    None,
+                )
+                step.extend(self._propose_now(proposal, prop_rng, data))
             replay = self._future.pop(self._epoch, [])
             for sender, msg in replay:
                 remaining = self._future_per_sender.get(sender, 1) - 1

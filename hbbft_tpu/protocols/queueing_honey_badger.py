@@ -19,8 +19,10 @@ from hbbft_tpu.protocols.dynamic_honey_badger import (
     DynamicHoneyBadger,
     JoinPlan,
 )
+from hbbft_tpu.protocols.errors import ContributionNotEncodable
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+from hbbft_tpu.utils import serde
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
 from hbbft_tpu.protocols.transaction_queue import TransactionQueue
 
@@ -104,6 +106,12 @@ class QueueingHoneyBadger(ConsensusProtocol):
         if input.kind == "change":
             step = self.dhb.vote_for(input.value, rng)
         else:
+            # Validate at push: a bad transaction must fail HERE, not
+            # epochs later when the queue happens to sample it.
+            try:
+                serde.dumps(input.value)
+            except serde.EncodeError as e:
+                raise ContributionNotEncodable(str(e)) from e
             self.queue.push(input.value)
             step = Step.empty()
         return step.extend(self._propose(rng))

@@ -1,0 +1,435 @@
+"""Safe-codec tests: round trips, strictness, and Byzantine rejection.
+
+The codec replaces the reference's ``bincode`` boundary (upstream
+``src/honey_badger/honey_badger.rs`` serializes contributions before
+threshold-encrypting them).  Committed payloads are attacker-authored, so
+``loads`` must be total over arbitrary bytes: decode a registered value
+or raise — never execute code, never construct unregistered types.
+"""
+
+import pickle
+import random
+
+import pytest
+
+from hbbft_tpu.crypto.keys import Ciphertext, SecretKey
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    Change,
+    InternalContrib,
+    JoinPlan,
+    SignedKeyGenMsg,
+    SignedVote,
+)
+from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen
+from hbbft_tpu.utils import serde
+from hbbft_tpu.utils.serde import DecodeError
+
+SUITE = ScalarSuite()
+
+
+@pytest.fixture
+def rng():
+    return random.Random(42)
+
+
+def roundtrip(obj):
+    data = serde.dumps(obj)
+    assert isinstance(data, bytes)
+    out = serde.loads(data)
+    assert out == obj
+    # byte stability: same object -> same bytes
+    assert serde.dumps(out) == data
+    return out
+
+
+# -- primitives -------------------------------------------------------------
+
+
+def test_primitive_roundtrips():
+    for obj in [
+        None,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        2**300,
+        -(2**300),
+        b"",
+        b"\x00\xff" * 100,
+        "",
+        "unicode é中",
+        (),
+        (1, (2, (3,))),
+        [],
+        [1, "two", b"three", None],
+        {},
+        {"a": 1, 2: b"b", (1, 2): "tuple-key"},
+    ]:
+        roundtrip(obj)
+
+
+def test_bool_int_distinction():
+    assert serde.loads(serde.dumps(True)) is True
+    assert serde.loads(serde.dumps(1)) == 1
+    assert serde.dumps(True) != serde.dumps(1)
+
+
+def test_unencodable_types_raise():
+    with pytest.raises(serde.EncodeError):
+        serde.dumps(object())
+    with pytest.raises(serde.EncodeError):
+        serde.dumps(lambda: None)
+    with pytest.raises(serde.EncodeError):
+        serde.dumps({1: object()})
+
+
+# -- strictness over raw bytes ---------------------------------------------
+
+
+def test_malformed_bytes_rejected():
+    bad = [
+        b"",
+        b"\xff",
+        b"\x03",  # truncated int
+        b"\x03\x02\x00\x00\x00\x01\x05",  # bad sign byte
+        b"\x03\x00\x00\x00\x00\x02\x00\x01",  # non-minimal int
+        b"\x03\x01\x00\x00\x00\x00",  # negative zero
+        b"\x04\xff\xff\xff\xff",  # bytes len >> input
+        b"\x06\xff\xff\xff\xff",  # tuple count >> input
+        b"\x05\x00\x00\x00\x01\xff",  # invalid utf-8
+        b"\x10\x05bogus\x06\x00\x00\x00\x00",  # unknown struct
+        b"\x11\x03xyz\x01\x00\x00\x00\x00",  # unknown suite
+        serde.dumps((1, 2))[:-1],  # truncation
+        serde.dumps((1, 2)) + b"\x00",  # trailing bytes
+    ]
+    for data in bad:
+        assert serde.try_loads(data) is None, data
+        with pytest.raises(DecodeError):
+            serde.loads(data)
+
+
+def test_depth_bomb_rejected():
+    # 1000 nested tuples: encoder refuses to build it, decoder refuses
+    # hand-rolled bytes at the same bound.
+    data = b"\x06\x00\x00\x00\x01" * 1000 + b"\x00"
+    assert serde.try_loads(data) is None
+
+
+def test_pickle_bytes_rejected():
+    for payload in [["tx"], {"a": 1}, object()]:
+        try:
+            blob = pickle.dumps(payload)
+        except Exception:
+            continue
+        assert serde.try_loads(blob) is None
+
+
+def test_duplicate_dict_key_rejected():
+    one = serde.dumps(1)
+    item = one + one
+    data = b"\x08" + (2).to_bytes(4, "big") + item + item
+    assert serde.try_loads(data) is None
+
+
+# -- crypto types -----------------------------------------------------------
+
+
+def test_ciphertext_roundtrip_and_decrypt(rng):
+    sk = SecretKey.random(rng, SUITE)
+    ct = sk.public_key().encrypt(b"payload", rng)
+    ct2 = roundtrip(ct)
+    assert isinstance(ct2, Ciphertext)
+    assert sk.decrypt(ct2) == b"payload"
+
+
+def test_group_element_range_enforced(rng):
+    sk = SecretKey.random(rng, SUITE)
+    ct = sk.public_key().encrypt(b"x", rng)
+    data = bytearray(serde.dumps(ct))
+    # Overwrite the first group element payload with r (out of range).
+    idx = bytes(data).index(b"\x11")
+    # tag(1) + namelen(1) + name + group(1) + len(4) -> payload
+    name_len = data[idx + 1]
+    payload_at = idx + 2 + name_len + 1 + 4
+    data[payload_at : payload_at + 32] = SUITE.scalar_modulus.to_bytes(32, "big")
+    assert serde.try_loads(bytes(data)) is None
+
+
+def test_signature_and_votes_roundtrip(rng):
+    sk = SecretKey.random(rng, SUITE)
+    pk = sk.public_key()
+    change = Change.node_change({"a": pk, "b": pk})
+    vote = SignedVote("a", 0, 3, change, sk.sign(b"payload"))
+    roundtrip(vote)
+    roundtrip(InternalContrib(["t1", "t2"], (), (vote,)))
+    roundtrip(EncryptionSchedule.tick_tock(2))
+
+
+def test_vote_with_wrong_signature_type_rejected(rng):
+    sk = SecretKey.random(rng, SUITE)
+    change = Change.node_change({"a": sk.public_key()})
+    vote = SignedVote("a", 0, 1, change, sk.sign(b"m"))
+    data = serde.dumps(vote)
+    # Splice: replace the struct name "svote"'s signature field by
+    # re-encoding with a non-Signature: build the tuple by hand.
+    forged = serde.dumps(("a", 0, 1, change, b"not-a-signature"))
+    # direct unpack-level check via a hand-built struct frame
+    frame = b"\x10" + bytes([len(b"svote")]) + b"svote" + forged
+    assert serde.try_loads(frame) is None
+    assert serde.loads(data) == vote
+
+
+def test_change_cross_field_invariants_enforced(rng):
+    sk = SecretKey.random(rng, SUITE)
+    pk = sk.public_key()
+
+    def frame(fields):
+        return (
+            b"\x10" + bytes([len(b"change")]) + b"change" + serde.dumps(fields)
+        )
+
+    # schedule change without a schedule -> would crash encrypt_on(None)
+    assert serde.try_loads(frame(("encryption_schedule", (), None))) is None
+    # schedule change smuggling validators
+    assert (
+        serde.try_loads(
+            frame(
+                (
+                    "encryption_schedule",
+                    (("a", pk),),
+                    EncryptionSchedule.always(),
+                )
+            )
+        )
+        is None
+    )
+    # node change with empty validator set -> threshold -1
+    assert serde.try_loads(frame(("node_change", (), None))) is None
+    # node change smuggling a schedule
+    assert (
+        serde.try_loads(
+            frame(("node_change", (("a", pk),), EncryptionSchedule.always()))
+        )
+        is None
+    )
+    # honest constructions still round-trip
+    roundtrip(Change.node_change({"a": pk}))
+    roundtrip(Change.encryption_schedule(EncryptionSchedule.tick_tock(2)))
+
+
+def test_dkg_part_ack_roundtrip(rng):
+    ids = ["n0", "n1", "n2", "n3"]
+    sks = {i: SecretKey.random(rng, SUITE) for i in ids}
+    pub = {i: sks[i].public_key() for i in ids}
+    kg, part = SyncKeyGen.new("n0", sks["n0"], pub, 1, rng, SUITE)
+    part2 = roundtrip(part)
+    outcome = kg.handle_part("n0", part2, rng)
+    assert outcome.is_valid and outcome.ack is not None
+    roundtrip(outcome.ack)
+    msg = SignedKeyGenMsg(0, "n0", part, sks["n0"].sign(b"kg"))
+    roundtrip(msg)
+
+
+def test_join_plan_roundtrip(rng):
+    from hbbft_tpu.crypto.keys import SecretKeySet
+
+    sks = SecretKeySet.random(1, rng, SUITE)
+    pks = sks.public_keys()
+    reg = {i: SecretKey.random(rng, SUITE).public_key() for i in "abcd"}
+    plan = JoinPlan(
+        2,
+        pks,
+        tuple(sorted(reg.items())),
+        EncryptionSchedule.always(),
+    )
+    plan2 = roundtrip(plan)
+    assert plan2.public_key_set.public_key() == pks.public_key()
+
+
+def test_node_id_restricted_to_plain_scalars(rng):
+    sk = SecretKey.random(rng, SUITE)
+    change = Change.node_change({"a": sk.public_key()})
+    # voter id as a tuple: encodable as a value, but rejected as node id
+    forged = serde.dumps((("evil", "tuple"), 0, 1, change, sk.sign(b"m")))
+    frame = b"\x10" + bytes([len(b"svote")]) + b"svote" + forged
+    assert serde.try_loads(frame) is None
+
+
+def test_unencodable_contribution_raises_at_input_boundary(rng):
+    """API misuse raises a typed error BEFORE any state change — a bad
+    transaction cannot crash the node epochs later (upstream analog:
+    bincode's Serialize bound rejects at compile time)."""
+    from hbbft_tpu.net import NetBuilder
+    from hbbft_tpu.protocols.errors import ContributionNotEncodable
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+    from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+
+    class CustomTxn:
+        pass
+
+    net = (
+        NetBuilder(4, seed=13)
+        .num_faulty(0)
+        .protocol(lambda ni, sink, rng: HoneyBadger(ni, sink))
+        .build()
+    )
+    hb = net.node(0).protocol
+    with pytest.raises(ContributionNotEncodable):
+        hb.handle_input(CustomTxn(), rng)
+    assert not hb.has_input  # no state change
+
+    qnet = (
+        NetBuilder(4, seed=13)
+        .num_faulty(0)
+        .protocol(lambda ni, sink, rng: QueueingHoneyBadger(ni, sink, batch_size=8))
+        .build()
+    )
+    qhb = qnet.node(0).protocol
+    with pytest.raises(ContributionNotEncodable):
+        qhb.push_transaction(CustomTxn(), rng)
+    assert len(qhb.queue) == 0  # never queued
+
+
+def test_none_contribution_is_not_a_fault():
+    """An honest proposer of None must not be faulted: decoded-None and
+    decode-failure are distinct."""
+    from hbbft_tpu.net import NetBuilder
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+
+    net = (
+        NetBuilder(4, seed=17)
+        .num_faulty(0)
+        .protocol(lambda ni, sink, rng: HoneyBadger(ni, sink))
+        .build()
+    )
+    net.broadcast_input(lambda nid: None if nid == 0 else [f"tx-{nid}"])
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= 1 for i in n.correct_ids)
+    )
+    assert net.correct_faults() == []
+    batch = net.node(1).outputs[0]
+    cm = batch.contribution_map()
+    if 0 in cm:  # Subset may or may not include node 0's proposal
+        assert cm[0] is None
+
+
+# -- BLS suite --------------------------------------------------------------
+
+
+def test_bls_ciphertext_roundtrip(rng):
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    sk = SecretKey.random(rng, suite)
+    ct = sk.public_key().encrypt(b"bls payload", rng)
+    ct2 = roundtrip(ct)
+    assert sk.decrypt(ct2) == b"bls payload"
+
+
+def test_suite_pinning_rejects_other_suites(rng):
+    """A deployment pins its suite: bytes naming any other suite (e.g.
+    the INSECURE ScalarSuite in a BLS network) are rejected at the frame
+    level, so a Byzantine proposer cannot select forgeable crypto."""
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    bls = BLSSuite()
+    sk = SecretKey.random(rng, SUITE)
+    scalar_ct = sk.public_key().encrypt(b"x", rng)
+    data = serde.dumps(scalar_ct)
+    # unpinned: decodes fine; pinned to BLS: rejected
+    assert serde.loads(data) == scalar_ct
+    assert serde.try_loads(data, suite=bls) is None
+    with pytest.raises(DecodeError, match="not allowed"):
+        serde.loads(data, suite=bls)
+    # pinned to its own suite: fine
+    assert serde.loads(data, suite=SUITE) == scalar_ct
+
+
+def test_honey_badger_decodes_with_pinned_suite():
+    """HoneyBadger passes its network suite into serde decoding."""
+    from hbbft_tpu.net import NetBuilder
+    from hbbft_tpu.protocols.honey_badger import HoneyBadger
+
+    net = (
+        NetBuilder(4, seed=21)
+        .num_faulty(0)
+        .protocol(lambda ni, sink, rng: HoneyBadger(ni, sink))
+        .build()
+    )
+    net.broadcast_input(lambda nid: [f"tx-{nid}"])
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= 1 for i in n.correct_ids)
+    )
+    batches = [net.node(i).outputs[0] for i in net.correct_ids]
+    assert all(b == batches[0] for b in batches)
+    assert net.correct_faults() == []
+
+
+def test_bls_identity_point_roundtrip_and_canonical():
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    ident = suite.g1_identity()
+    assert suite.g1_from_bytes(ident.to_bytes()) == ident
+    # non-canonical identity (flag 0 but nonzero body) rejected
+    bad = b"\x00" + b"\x01" * 96
+    with pytest.raises(ValueError):
+        suite.g1_from_bytes(bad)
+    ident2 = suite.g2_identity()
+    assert suite.g2_from_bytes(ident2.to_bytes()) == ident2
+
+
+def test_bls_non_subgroup_point_rejected():
+    """An on-curve G1 point OUTSIDE the r-torsion subgroup must be
+    rejected at decode (CLAUDE.md invariant: wire-sourced points get
+    subgroup checks).  A random on-curve point lies outside the subgroup
+    with overwhelming probability (cofactor ~2^125)."""
+    from hbbft_tpu.crypto.bls import fields as F
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    P = F.P
+    x = 5
+    while True:
+        rhs = (x * x * x + 4) % P
+        y = pow(rhs, (P + 1) // 4, P)  # sqrt (p % 4 == 3)
+        if y * y % P == rhs:
+            break
+        x += 1
+    enc = b"\x01" + x.to_bytes(48, "big") + y.to_bytes(48, "big")
+    with pytest.raises(ValueError):
+        suite.g1_from_bytes(enc)
+    # sanity: same encoding with a generator multiple IS accepted
+    g = suite.g1_generator() * 12345
+    assert suite.g1_from_bytes(g.to_bytes()) == g
+
+
+def test_bls_subgroup_memo_single_check():
+    """The torsion memo: a second is_g1 on the same element skips the
+    scalar mult (observable via the private flag)."""
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    g = suite.g1_generator() * 7
+    assert not g._subgroup_ok
+    assert suite.is_g1(g)
+    assert g._subgroup_ok
+    assert suite.is_g1(g)  # second call: memo hit
+
+
+def test_bls_off_curve_point_rejected(rng):
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+
+    suite = BLSSuite()
+    sk = SecretKey.random(rng, suite)
+    ct = sk.public_key().encrypt(b"x", rng)
+    data = bytearray(serde.dumps(ct))
+    # find the G1 payload (97 bytes after the group header) and corrupt y
+    idx = bytes(data).index(b"\x11")
+    name_len = data[idx + 1]
+    payload_at = idx + 2 + name_len + 1 + 4
+    data[payload_at + 96] ^= 1  # flip a bit of y
+    assert serde.try_loads(bytes(data)) is None

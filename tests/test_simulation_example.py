@@ -1,0 +1,35 @@
+"""Smoke test: the virtual-time simulation example runs end-to-end.
+
+Reference analog: upstream ``examples/simulation.rs`` (SURVEY.md §2 #17)
+— the reference's only benchmark artifact.  A tiny config keeps this
+fast; the point is that the example's whole pipeline (DHB + SenderQueue
+messages through the hardware model, message sizing, flush metrics)
+stays runnable, since it is part of the bench workflow.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_simulation_example_smoke():
+    result = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "examples" / "simulation.py"),
+            "--nodes",
+            "4",
+            "--txns",
+            "8",
+            "--batch-size",
+            "4",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=str(REPO),
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "committed" in result.stdout
