@@ -276,6 +276,7 @@ class DynamicHoneyBadger(ConsensusProtocol):
         max_future_epochs: int = 3,
         encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
         suite: Any = None,
+        subset_handling: str = "incremental",
     ) -> None:
         self._netinfo = netinfo
         self._sink = sink
@@ -283,6 +284,7 @@ class DynamicHoneyBadger(ConsensusProtocol):
         self._era = era
         self.max_future_epochs = max_future_epochs
         self.encryption_schedule = encryption_schedule
+        self.subset_handling = subset_handling
         self._suite = suite if suite is not None else _suite_of(netinfo)
         self._hb: HoneyBadger = self._make_hb()
         self._vote_counter = VoteCounter()
@@ -333,6 +335,7 @@ class DynamicHoneyBadger(ConsensusProtocol):
             session_id=canonical_bytes(self._session_id, self._era),
             max_future_epochs=self.max_future_epochs,
             encryption_schedule=self.encryption_schedule,
+            subset_handling=self.subset_handling,
         )
 
     # -- ConsensusProtocol --------------------------------------------

@@ -45,6 +45,15 @@ FAULT_BAD_CONTRIBUTION = "honey_badger:undecodable-contribution"
 SUBSET = "subset"
 DECRYPT = "decrypt"
 
+# SubsetHandlingStrategy (upstream ``src/honey_badger/`` builder option):
+# "incremental" starts decrypting each accepted contribution as Subset
+# emits it; "all_at_end" defers until Subset completes, then processes
+# the whole set at once.  Final batches are identical either way — the
+# strategy only trades decryption-latency overlap against doing one
+# batched pass (which also gives the verify pool a bigger flush batch).
+INCREMENTAL = "incremental"
+ALL_AT_END = "all_at_end"
+
 
 # ---------------------------------------------------------------------------
 # Encryption schedule
@@ -131,6 +140,7 @@ class _EpochState:
         )
         self.decrypts: Dict[Any, ThresholdDecrypt] = {}
         self.accepted: Dict[Any, bytes] = {}  # proposer -> subset payload
+        self.pending_payloads: List[Tuple[Any, bytes]] = []  # all_at_end buffer
         self.subset_done = False
         self.decrypted: Dict[Any, Any] = {}
         self.faulty_proposers: Set[Any] = set()
@@ -151,9 +161,15 @@ class _EpochState:
         step = Step.empty()
         if out.kind == "contribution":
             self.accepted[out.proposer] = out.value
-            step.extend(self._start_decrypt(out.proposer, out.value))
+            if self.hb.subset_handling == ALL_AT_END:
+                self.pending_payloads.append((out.proposer, out.value))
+            else:
+                step.extend(self._start_decrypt(out.proposer, out.value))
         elif out.kind == "done":
             self.subset_done = True
+            pending, self.pending_payloads = self.pending_payloads, []
+            for proposer, value in pending:
+                step.extend(self._start_decrypt(proposer, value))
             step.extend(self._try_batch())
         return step
 
@@ -268,12 +284,16 @@ class HoneyBadger(ConsensusProtocol):
         session_id: bytes = b"hb",
         max_future_epochs: int = 3,
         encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
+        subset_handling: str = INCREMENTAL,
     ) -> None:
+        if subset_handling not in (INCREMENTAL, ALL_AT_END):
+            raise ValueError(f"bad subset_handling: {subset_handling!r}")
         self._netinfo = netinfo
         self._sink = sink
         self._session_id = bytes(session_id)
         self.max_future_epochs = max_future_epochs
         self.encryption_schedule = encryption_schedule
+        self.subset_handling = subset_handling
         self._epoch = 0
         self._state = _EpochState(self, 0)
         self._future: Dict[int, List[Tuple[Any, HbMessage]]] = {}

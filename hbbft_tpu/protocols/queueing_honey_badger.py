@@ -53,6 +53,7 @@ class QueueingHoneyBadger(ConsensusProtocol):
         max_future_epochs: int = 3,
         encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
         dhb: Optional[DynamicHoneyBadger] = None,
+        subset_handling: str = "incremental",
     ) -> None:
         self.batch_size = batch_size
         self.queue = TransactionQueue()
@@ -67,6 +68,7 @@ class QueueingHoneyBadger(ConsensusProtocol):
             session_id=session_id,
             max_future_epochs=max_future_epochs,
             encryption_schedule=encryption_schedule,
+            subset_handling=subset_handling,
         )
 
     @staticmethod

@@ -31,13 +31,36 @@ class TransactionQueue:
         self._txns.append(txn)
 
     def remove_multiple(self, txns: Iterable[Any]) -> None:
-        """Drop committed transactions (compares by equality)."""
+        """Drop committed transactions (compares by equality).
+
+        One O(queue + committed) pass: multiset-subtract the committed
+        transactions (each committed occurrence removes at most one
+        queued occurrence, matching per-item ``list.remove`` semantics).
+        The old per-item scan was O(committed x queue) — quadratic at
+        firehose batch sizes.  Unhashable transactions fall back to the
+        equality scan (rare; transactions are normally plain data).
+        """
         committed = list(txns)
-        for t in committed:
-            try:
-                self._txns.remove(t)
-            except ValueError:
-                pass
+        if not committed or not self._txns:
+            return
+        try:
+            pending: dict = {}
+            for t in committed:
+                pending[t] = pending.get(t, 0) + 1
+            kept: List[Any] = []
+            for t in self._txns:
+                n = pending.get(t, 0)
+                if n:
+                    pending[t] = n - 1
+                else:
+                    kept.append(t)
+            self._txns = kept
+        except TypeError:
+            for t in committed:
+                try:
+                    self._txns.remove(t)
+                except ValueError:
+                    pass
 
     def choose(self, rng: Any, amount: int) -> List[Any]:
         """A random sample of up to ``amount`` pending transactions."""
