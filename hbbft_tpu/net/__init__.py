@@ -13,5 +13,6 @@ from hbbft_tpu.net.adversary import (  # noqa: F401
     NullAdversary,
     RandomAdversary,
     ReorderingAdversary,
+    TamperingAdversary,
 )
 from hbbft_tpu.net.virtual_net import CrankError, NetBuilder, VirtualNet  # noqa: F401

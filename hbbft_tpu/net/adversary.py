@@ -35,6 +35,14 @@ class Adversary:
         """
         return []
 
+    def on_input_to_faulty(
+        self, net: "VirtualNet", node_id: Any, input: Any, rng: Any
+    ) -> List["NetMessage"]:
+        """React to ``broadcast_input`` offering an input to a faulty
+        node.  Crash-style adversaries ignore it (return []); algorithm-
+        running adversaries feed it to ``net.faulty_nodes[node_id]``."""
+        return []
+
 
 class NullAdversary(Adversary):
     """FIFO delivery, silent faulty nodes."""
@@ -63,6 +71,133 @@ class ReorderingAdversary(Adversary):
 
     def on_message_to_faulty(self, net, msg, rng):
         return []
+
+
+class TamperingAdversary(Adversary):
+    """Runs the REAL algorithm on each faulty node and rewrites its
+    outgoing messages: valid types, wrong contents (flipped BVals/Aux,
+    corrupted Merkle proofs and roots, wrong-but-well-formed signature
+    and decryption shares).  Upstream analog: ``tamper`` in
+    ``tests/net/adversary.rs``.
+
+    This exercises the hardest Byzantine class the stock adversaries
+    missed: syntactically-valid-but-wrong protocol message streams.
+    Correct nodes must still agree, and their fault logs must pin the
+    faulty senders.  ``tamper_p`` < 1 interleaves honest and tampered
+    traffic from the same faulty node (more adversarial than pure noise,
+    which degenerates to crash-faulty behavior).
+    """
+
+    def __init__(self, tamper_p: float = 0.5) -> None:
+        assert 0.0 <= tamper_p <= 1.0
+        self.tamper_p = tamper_p
+        self.tampered_count = 0
+
+    # -- harness hooks --------------------------------------------------
+    def on_input_to_faulty(self, net, node_id, input, rng):
+        node = net.faulty_nodes.get(node_id)
+        if node is None:
+            return []
+        step = node.protocol.handle_input(input, node.rng)
+        return self._drive(net, node, step, rng)
+
+    def on_message_to_faulty(self, net, msg, rng):
+        node = net.faulty_nodes.get(msg.dest)
+        if node is None:
+            return []
+        step = node.protocol.handle_message(msg.sender, msg.payload, node.rng)
+        return self._drive(net, node, step, rng)
+
+    # -- internals ------------------------------------------------------
+    def _drive(self, net, node, step, rng) -> List["NetMessage"]:
+        """Expand a faulty node's Step (and its deferred-verify flushes)
+        into tampered network messages."""
+        from hbbft_tpu.net.virtual_net import NetMessage
+
+        out: List[NetMessage] = []
+        steps = [step]
+        while node.pool:
+            steps.append(node.pool.flush(net.backend))
+        for s in steps:
+            for tm in s.messages:
+                payload = tm.message
+                if rng.random() < self.tamper_p:
+                    tampered = self._tamper(payload, rng)
+                    if tampered is not payload:
+                        self.tampered_count += 1
+                    payload = tampered
+                for dest in tm.target.recipients(net.node_order, node.id):
+                    out.append(NetMessage(sender=node.id, dest=dest, payload=payload))
+        return out
+
+    def _tamper(self, payload: Any, rng: Any) -> Any:
+        """Rewrite one protocol message: dispatch on the innermost
+        protocol content, rebuilding the (frozen dataclass) envelope
+        chain around it.  Unknown leaves pass through untouched."""
+        import dataclasses
+
+        from hbbft_tpu.crypto.keys import DecryptionShare, SignatureShare
+        from hbbft_tpu.protocols.binary_agreement import ConfMsg, TermMsg
+        from hbbft_tpu.protocols.broadcast import (
+            CanDecodeMsg,
+            EchoHashMsg,
+            EchoMsg,
+            ReadyMsg,
+            ValueMsg,
+        )
+        from hbbft_tpu.protocols.bool_set import BoolSet
+        from hbbft_tpu.protocols.sbv_broadcast import AuxMsg, BValMsg
+        from hbbft_tpu.protocols.threshold_decrypt import DecryptMessage
+        from hbbft_tpu.protocols.threshold_sign import SignMessage
+
+        def flip_root(root: bytes) -> bytes:
+            return bytes([root[0] ^ 1]) + root[1:]
+
+        t = type(payload)
+        if t is BValMsg:
+            return BValMsg(not payload.value)
+        if t is AuxMsg:
+            return AuxMsg(not payload.value)
+        if t is TermMsg:
+            return TermMsg(not payload.value)
+        if t is ConfMsg:
+            flipped = BoolSet.both() if len(payload.vals) < 2 else BoolSet.single(
+                bool(rng.getrandbits(1))
+            )
+            return ConfMsg(flipped)
+        if t is SignMessage:
+            s = payload.share
+            return SignMessage(SignatureShare(s.g2 * 2, s.suite))
+        if t is DecryptMessage:
+            s = payload.share
+            return DecryptMessage(DecryptionShare(s.g1 * 2, s.suite))
+        if t is ReadyMsg:
+            return ReadyMsg(flip_root(payload.root))
+        if t is EchoHashMsg:
+            return EchoHashMsg(flip_root(payload.root))
+        if t is CanDecodeMsg:
+            return CanDecodeMsg(flip_root(payload.root))
+        if t in (ValueMsg, EchoMsg):
+            proof = payload.proof
+            bad_value = (
+                bytes([proof.value[0] ^ 1]) + proof.value[1:]
+                if proof.value
+                else b"\x01"
+            )
+            bad = dataclasses.replace(proof, value=bad_value)
+            return t(bad)
+        if dataclasses.is_dataclass(payload) and not isinstance(payload, type):
+            # Envelope (SubsetMessage/HbMessage/DhbMessage/AbaMessage/
+            # CoinMsg/SqMessage/...): recurse into its fields.
+            changes = {}
+            for f in dataclasses.fields(payload):
+                v = getattr(payload, f.name)
+                nv = self._tamper(v, rng)
+                if nv is not v:
+                    changes[f.name] = nv
+            if changes:
+                return dataclasses.replace(payload, **changes)
+        return payload
 
 
 class RandomAdversary(Adversary):

@@ -116,9 +116,15 @@ class VirtualNet:
         rng: random.Random,
         flush_every: int = 1,
         max_cranks: int = 100_000,
+        faulty_nodes: Optional[Dict[Any, "VirtualNode"]] = None,
     ) -> None:
         self.nodes = nodes
         self.faulty_ids = list(faulty_ids)
+        # Protocol instances for adversary-controlled nodes (used by
+        # tampering adversaries that run the real algorithm and rewrite
+        # its outgoing messages, upstream ``tamper``); silent/crash-style
+        # adversaries simply never touch them.
+        self.faulty_nodes: Dict[Any, VirtualNode] = dict(faulty_nodes or {})
         self.backend = backend
         self.adversary = adversary
         self.rng = rng
@@ -187,6 +193,11 @@ class VirtualNet:
     def broadcast_input(self, input_fn: Callable[[Any], Any]) -> None:
         for nid in sorted(self.nodes):
             self.send_input(nid, input_fn(nid))
+        for nid in sorted(self.faulty_ids):
+            for m in self.adversary.on_input_to_faulty(
+                self, nid, input_fn(nid), self.rng
+            ):
+                self.queue.append(m)
 
     def inject(self, msg: NetMessage) -> None:
         self.queue.append(msg)
@@ -342,8 +353,7 @@ class NetBuilder:
         node_sks = {i: SecretKey.random(rng, suite) for i in ids}
         node_pks = {i: node_sks[i].public_key() for i in ids}
 
-        nodes: Dict[Any, VirtualNode] = {}
-        for i in correct_ids:
+        def make_node(i: Any) -> VirtualNode:
             is_val = i in val_ids
             netinfo = NetworkInfo(
                 our_id=i,
@@ -356,9 +366,15 @@ class NetBuilder:
             pool = VerifyPool()
             node_rng = random.Random((self.seed << 16) ^ (i + 1))
             proto = self._protocol_factory(netinfo, pool, node_rng)
-            nodes[i] = VirtualNode(
+            return VirtualNode(
                 id=i, netinfo=netinfo, protocol=proto, pool=pool, rng=node_rng
             )
+
+        nodes = {i: make_node(i) for i in correct_ids}
+        # Faulty nodes get real instances too (their key shares exist in
+        # any case — the dealer handed them out).  Whether these run is
+        # the adversary's choice: crash-style ones ignore them.
+        faulty_nodes = {i: make_node(i) for i in faulty_ids}
 
         return VirtualNet(
             nodes=nodes,
@@ -368,4 +384,5 @@ class NetBuilder:
             rng=rng,
             flush_every=self._flush_every,
             max_cranks=self._max_cranks,
+            faulty_nodes=faulty_nodes,
         )

@@ -1,0 +1,147 @@
+"""TamperingAdversary: faulty nodes send valid-type/wrong-content streams.
+
+Upstream analog: ``tamper`` in ``tests/net/adversary.rs`` (SURVEY.md §4)
+— rewrite messages originating from faulty nodes.  The assertions are
+the upstream ones: correct nodes still terminate and agree, correct
+nodes are never faulted, and the fault logs pin (only) faulty senders.
+"""
+
+import pytest
+
+from hbbft_tpu.net import NetBuilder, TamperingAdversary
+from hbbft_tpu.protocols.broadcast import Broadcast
+from hbbft_tpu.protocols.honey_badger import HoneyBadger
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.threshold_sign import ThresholdSign
+
+SEEDS = [101, 202, 303, 404, 505]
+
+
+def faulty_fault_ids(net):
+    """ids faulted by correct nodes (should be a subset of faulty_ids)."""
+    return {f.node_id for n in net.nodes.values() for f in n.faults}
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_threshold_sign_under_tampering(seed):
+    adv = TamperingAdversary(tamper_p=1.0)
+    net = (
+        NetBuilder(7, seed=seed)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, b"tamper-doc", sink))
+        .adversary(adv)
+        .build()
+    )
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    outs = [net.node(i).outputs[0] for i in net.correct_ids]
+    assert all(o == outs[0] for o in outs)
+    pks = net.node(0).netinfo.public_key_set
+    assert pks.verify_signature(b"tamper-doc", outs[0])
+    assert net.correct_faults() == []
+    # every fault recorded names a faulty node (evidence is best-effort:
+    # a node that terminates before a tampered share arrives correctly
+    # ignores it, so not every seed records faults)
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
+    assert adv.tampered_count > 0
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_broadcast_under_tampering(seed):
+    """Faulty (non-proposer) nodes corrupt Echo proofs/Ready roots; the
+    proposer's value must still deliver identically everywhere."""
+    net = (
+        NetBuilder(10, seed=seed)
+        .protocol(lambda ni, sink, rng: Broadcast(ni, 0))
+        .adversary(TamperingAdversary(tamper_p=1.0))
+        .build()
+    )
+    net.send_input(0, b"tamper-payload-" + bytes([seed % 256]))
+    net.run_to_termination()
+    outs = [net.node(i).outputs[0] for i in net.correct_ids]
+    assert all(o == outs[0] for o in outs)
+    assert outs[0] == b"tamper-payload-" + bytes([seed % 256])
+    assert net.correct_faults() == []
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_honey_badger_under_tampering(seed):
+    net = (
+        NetBuilder(4, seed=seed)
+        .num_faulty(1)
+        .protocol(lambda ni, sink, rng: HoneyBadger(ni, sink))
+        .adversary(TamperingAdversary(tamper_p=0.5))
+        .build()
+    )
+    net.broadcast_input(lambda nid: [f"tx-{nid}"])
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= 1 for i in n.correct_ids),
+        max_cranks=400_000,
+    )
+    # second epoch under continued tampering
+    net.broadcast_input(lambda nid: [f"tx2-{nid}"])
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= 2 for i in n.correct_ids),
+        max_cranks=400_000,
+    )
+    for epoch in range(2):
+        batches = [net.node(i).outputs[epoch] for i in net.correct_ids]
+        assert all(b == batches[0] for b in batches), f"epoch {epoch} diverged"
+    # every correct proposer's contribution committed in epoch 0
+    cm = net.node(net.correct_ids[0]).outputs[0].contribution_map()
+    for nid in net.correct_ids:
+        if nid in cm:
+            assert cm[nid] == [f"tx-{nid}"]
+    assert net.correct_faults() == []
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_queueing_honey_badger_under_tampering(seed):
+    """Full stack (QHB -> DHB -> HB) with a tampering faulty validator."""
+    net = (
+        NetBuilder(4, seed=seed)
+        .num_faulty(1)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(ni, sink, batch_size=8)
+        )
+        .adversary(TamperingAdversary(tamper_p=0.5))
+        .build()
+    )
+    txns = {nid: [f"txn-{nid}-{k}" for k in range(3)] for nid in net.correct_ids}
+    for nid, ts in txns.items():
+        for t in ts:
+            net.send_input(nid, t)
+
+    def committed(n, nid):
+        out = []
+        for b in n.node(nid).outputs:
+            for _, contrib in b.contributions:
+                if isinstance(contrib, (list, tuple)):
+                    out.extend(contrib)
+        return out
+
+    want = sorted(t for ts in txns.values() for t in ts)
+    net.crank_until(
+        lambda n: all(
+            sorted(committed(n, i)) == want for i in n.correct_ids
+        ),
+        max_cranks=400_000,
+    )
+    assert net.correct_faults() == []
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
+
+
+def test_tampering_actually_tampers():
+    """Meta-check: the adversary rewrote a meaningful number of messages
+    (guards against the tamper dispatch silently matching nothing)."""
+    adv = TamperingAdversary(tamper_p=1.0)
+    net = (
+        NetBuilder(7, seed=1)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, b"d", sink))
+        .adversary(adv)
+        .build()
+    )
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    assert adv.tampered_count > 0
