@@ -31,7 +31,10 @@ from hbbft_tpu.protocols.queueing_honey_badger import (  # noqa: F401
     Input,
     QueueingHoneyBadger,
 )
-from hbbft_tpu.protocols.sender_queue import SenderQueue  # noqa: F401
+from hbbft_tpu.protocols.sender_queue import (  # noqa: F401
+    JoiningSenderQueue,
+    SenderQueue,
+)
 from hbbft_tpu.protocols.subset import Subset, SubsetOutput  # noqa: F401
 from hbbft_tpu.protocols.sync_key_gen import SyncKeyGen  # noqa: F401
 from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt  # noqa: F401

@@ -68,7 +68,15 @@ class NetworkInfo:
         return self._index.get(self._our_id)
 
     def is_validator(self) -> bool:
-        return self._our_id in self._index
+        """Whether WE actively participate: listed in the validator set
+        AND holding our threshold key share.  A node can be listed but
+        share-less — e.g. it joined from a ``JoinPlan`` of an era whose
+        DKG it did not observe; it then acts as an observer (commits
+        batches, signs nothing) until a later era's DKG deals it a
+        share.  Peers cannot distinguish this (``is_node_validator`` is
+        membership-only), which is safe: the protocols never rely on a
+        specific validator contributing, only on thresholds."""
+        return self._our_id in self._index and self._secret_key_share is not None
 
     def is_node_validator(self, node_id: Any) -> bool:
         return node_id in self._index

@@ -41,7 +41,7 @@ EpochId = Tuple[int, int]  # (era, epoch), lexicographic
 
 @dataclass(frozen=True)
 class SqMessage:
-    kind: str  # "epoch_started" | "algo"
+    kind: str  # "epoch_started" | "algo" | "join_plan"
     value: Any
 
     @staticmethod
@@ -51,6 +51,10 @@ class SqMessage:
     @staticmethod
     def algo(inner: Any) -> "SqMessage":
         return SqMessage("algo", inner)
+
+    @staticmethod
+    def join_plan(plan: Any) -> "SqMessage":
+        return SqMessage("join_plan", plan)
 
 
 def _hb_epoch_of(message: HbMessage) -> EpochId:
@@ -90,6 +94,14 @@ class SenderQueue(ConsensusProtocol):
         self._peer_epochs: Dict[Any, EpochId] = {p: (0, 0) for p in self._peers}
         self._outbox: Dict[Any, List[Tuple[EpochId, Any]]] = {p: [] for p in self._peers}
         self._last_announced: Optional[EpochId] = None
+        # Membership-change duties (upstream ``src/sender_queue/
+        # dynamic_honey_badger.rs``): current validator set (for diffing
+        # era changes), peers already handed a JoinPlan, and departing
+        # validators with the era whose announcement releases them.
+        self._validator_ids = set(_validator_ids_of(inner))
+        self._join_plan_sent: set = set()
+        self._departing: Dict[Any, int] = {}
+        self._removed: set = set()
 
     @classmethod
     def wrap(
@@ -131,6 +143,8 @@ class SenderQueue(ConsensusProtocol):
             return self._on_epoch_started(sender, message.value)
         if message.kind == "algo":
             return self._post(self.inner.handle_message(sender, message.value, rng))
+        if message.kind == "join_plan":
+            return Step.empty()  # already joined: nothing to do
         return Step.empty().fault(sender, FAULT_MALFORMED)
 
     # -- internals -----------------------------------------------------
@@ -142,6 +156,21 @@ class SenderQueue(ConsensusProtocol):
             or not all(isinstance(x, int) and not isinstance(x, bool) for x in epoch)
         ):
             return step.fault(peer, FAULT_MALFORMED)
+        dep_era = self._departing.get(peer)
+        if dep_era is not None and epoch[0] >= dep_era:
+            # Deferred removal completes: the departing validator has
+            # announced the era past its membership, i.e. it observed
+            # the change-complete batch — its last epoch's messages have
+            # drained and we stop serving it.
+            self._departing.pop(peer, None)
+            self._peer_epochs.pop(peer, None)
+            self._outbox.pop(peer, None)
+            if peer in self._peers:
+                self._peers.remove(peer)
+            self._removed.add(peer)
+            return step
+        if peer in self._removed:
+            return step  # gone until a future change re-adds it
         if peer not in self._peer_epochs:
             self._peer_epochs[peer] = (0, 0)
             self._outbox[peer] = []
@@ -178,6 +207,8 @@ class SenderQueue(ConsensusProtocol):
         step = Step(
             output=inner_step.output, messages=[], fault_log=inner_step.fault_log
         )
+        for out in inner_step.output:
+            self._on_batch(step, out)
         for tm in inner_step.messages:
             recipients = tm.target.recipients(self._peers, None)
             msg_epoch = self._epoch_of(tm.message)
@@ -190,6 +221,146 @@ class SenderQueue(ConsensusProtocol):
             self._last_announced = cur
             step.broadcast(SqMessage.epoch_started(cur))
         return step
+
+    def _on_batch(self, step: Step, out: Any) -> None:
+        """Membership-change duties on a change-complete batch (upstream
+        ``src/sender_queue/dynamic_honey_badger.rs``): hand the
+        ``JoinPlan`` to newly-added peers through the queue, and mark
+        removed validators as *departing* — they keep receiving their
+        final era's messages and are only dropped once they announce the
+        new era (deferred removal)."""
+        plan = getattr(out, "join_plan", None)
+        change = getattr(out, "change", None)
+        if plan is None or change is None or change.kind != "complete":
+            return
+        new_ids = set(plan.validator_map())
+        added = new_ids - self._validator_ids
+        removed = self._validator_ids - new_ids
+        self._validator_ids = new_ids
+        for peer in removed:
+            if peer != self.our_id and peer in self._peer_epochs:
+                self._departing[peer] = plan.era
+        for peer in sorted(added, key=str):
+            if peer == self.our_id:
+                continue
+            self._removed.discard(peer)
+            self._departing.pop(peer, None)
+            if peer not in self._peer_epochs:
+                self._peer_epochs[peer] = (plan.era, 0)
+                self._outbox[peer] = []
+                self._peers.append(peer)
+            if peer not in self._join_plan_sent:
+                self._join_plan_sent.add(peer)
+                step.send(peer, SqMessage.join_plan(plan))
+
+
+class JoiningSenderQueue(ConsensusProtocol):
+    """A node that is not yet a participant: it waits for a
+    :class:`~hbbft_tpu.protocols.dynamic_honey_badger.JoinPlan` handed
+    through a peer's SenderQueue, then constructs its protocol from the
+    plan and becomes a live :class:`SenderQueue` — no manual plumbing.
+
+    ``make_inner(join_plan, sink) -> protocol`` builds the inner
+    protocol (default: ``DynamicHoneyBadger.from_join_plan``; pass a
+    QHB-building factory for the queueing stack).  Messages arriving
+    before the plan are buffered (bounded) and replayed after joining.
+
+    Trust note: the first structurally-valid JoinPlan wins.  As in the
+    reference, JoinPlan distribution is application-trusted — a
+    deployment should deliver it over an authenticated link or
+    cross-check plans from multiple peers.
+    """
+
+    _MAX_BUFFER = 4096
+
+    def __init__(
+        self,
+        our_id: Any,
+        secret_key: Any,
+        sink: Any,
+        peers: List[Any],
+        make_inner: Optional[Callable[[Any, Any], ConsensusProtocol]] = None,
+        max_future_epochs: int = 3,
+        session_id: bytes = b"dhb",
+    ) -> None:
+        self._our_id = our_id
+        self._secret_key = secret_key
+        self._sink = sink
+        self._peers = list(peers)
+        self._max_future_epochs = max_future_epochs
+        self._session_id = session_id
+        self._make_inner = make_inner
+        self._sq: Optional[SenderQueue] = None
+        self._buffer: List[Tuple[Any, Any]] = []
+
+    @property
+    def our_id(self) -> Any:
+        return self._our_id
+
+    @property
+    def terminated(self) -> bool:
+        return False
+
+    @property
+    def joined(self) -> bool:
+        return self._sq is not None
+
+    @property
+    def inner(self) -> Optional[ConsensusProtocol]:
+        return self._sq.inner if self._sq is not None else None
+
+    def handle_input(self, input: Any, rng: Any) -> Step:
+        if self._sq is None:
+            return Step.empty()  # not a participant yet
+        return self._sq.handle_input(input, rng)
+
+    def handle_message(self, sender: Any, message: Any, rng: Any) -> Step:
+        if self._sq is not None:
+            return self._sq.handle_message(sender, message, rng)
+        if not isinstance(message, SqMessage):
+            return Step.empty().fault(sender, FAULT_MALFORMED)
+        if message.kind == "join_plan":
+            return self._join(message.value, sender, rng)
+        if len(self._buffer) < self._MAX_BUFFER:
+            self._buffer.append((sender, message))
+        return Step.empty()
+
+    def _join(self, plan: Any, sender: Any, rng: Any) -> Step:
+        from hbbft_tpu.protocols.dynamic_honey_badger import JoinPlan
+
+        if not isinstance(plan, JoinPlan):
+            return Step.empty().fault(sender, FAULT_MALFORMED)
+
+        def default_make(p: Any, sink: Any) -> ConsensusProtocol:
+            return DynamicHoneyBadger.from_join_plan(
+                self._our_id,
+                self._secret_key,
+                p,
+                sink,
+                session_id=self._session_id,
+                max_future_epochs=self._max_future_epochs,
+            )
+
+        make = self._make_inner or default_make
+        self._sq = SenderQueue.wrap(
+            lambda scoped: make(plan, scoped),
+            self._sink,
+            peers=self._peers,
+            max_future_epochs=self._max_future_epochs,
+        )
+        # Announce where we are and replay anything that arrived early.
+        step = self._sq._post(Step.empty())
+        buffered, self._buffer = self._buffer, []
+        for peer, msg in buffered:
+            step.extend(self._sq.handle_message(peer, msg, rng))
+        return step
+
+
+def _validator_ids_of(inner: ConsensusProtocol) -> Tuple[Any, ...]:
+    ni = getattr(inner, "netinfo", None)
+    if ni is None:
+        return ()
+    return tuple(getattr(ni, "all_ids", ()))
 
 
 def _default_epoch_of(inner: ConsensusProtocol) -> Callable[[Any], EpochId]:

@@ -1,0 +1,162 @@
+"""SenderQueue membership-change duties (upstream ``src/sender_queue/``).
+
+Two capabilities beyond epoch gating:
+
+* JoinPlan handover: when a change-complete batch adds validators, each
+  SenderQueue hands the ``JoinPlan`` to the new peers through the queue;
+  a :class:`JoiningSenderQueue` node constructs its protocol from the
+  received plan and commits the next era's batches — no manual plumbing.
+* Deferred removal: a validator removed by a change keeps receiving its
+  final era's messages (so it can commit the change-complete batch) and
+  is only dropped from the peer set once it announces the new era.
+"""
+
+import random
+
+from hbbft_tpu.crypto.keys import SecretKey
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import JoiningSenderQueue, SenderQueue
+
+
+def build_sq_net(n=4, seed=0, batch_size=8):
+    def factory(ni, sink, rng):
+        return SenderQueue.wrap(
+            lambda s: QueueingHoneyBadger(
+                ni, s, batch_size=batch_size, session_id=b"sq-churn"
+            ),
+            sink,
+            peers=list(range(n)),
+        )
+
+    return (
+        NetBuilder(n, seed=seed).num_faulty(0).protocol(factory).build()
+    )
+
+
+def batches_of(net, nid):
+    return [o for o in net.node(nid).outputs if isinstance(o, DhbBatch)]
+
+
+def drive_epochs(net, txn_prefix, rounds=6, stop=None):
+    for r in range(rounds):
+        if stop is not None and stop(net):
+            return
+        for nid in sorted(net.nodes):
+            proto = net.node(nid).protocol
+            net.send_input(nid, Input.user(f"{txn_prefix}-{r}-{nid}"))
+        net.crank_until(
+            lambda n, want=r + 1: all(
+                len(batches_of(n, i)) >= want
+                for i in n.correct_ids
+                if isinstance(n.node(i).protocol, SenderQueue)
+            ),
+            max_cranks=200_000,
+        )
+    if stop is not None:
+        assert stop(net), "condition not reached within driven epochs"
+
+
+def test_join_via_sender_queue_mid_era_change():
+    """A brand-new node joins THROUGH the queue: existing validators vote
+    it in, the change-complete batch's JoinPlan is delivered by peers'
+    SenderQueues, the joiner self-constructs and commits era-1 batches."""
+    net = build_sq_net(n=4, seed=71)
+    suite = ScalarSuite()
+    sk4 = SecretKey.random(random.Random(999), suite)
+    pk4 = sk4.public_key()
+
+    # The joining node exists on the network (transport-wise) but has no
+    # protocol state: only a JoiningSenderQueue awaiting a plan.
+    def joiner_factory(sink, rng):
+        return JoiningSenderQueue(
+            4,
+            sk4,
+            sink,
+            peers=[0, 1, 2, 3],
+            make_inner=lambda plan, s: QueueingHoneyBadger.from_join_plan(
+                4, sk4, plan, s, batch_size=8, session_id=b"sq-churn"
+            ),
+        )
+
+    net.add_node(4, joiner_factory)
+
+    # Vote to add node 4 (complete new map, upstream Change::NodeChange).
+    new_map = dict(net.node(0).netinfo.public_key_map)
+    new_map[4] = pk4
+    change = Change.node_change(new_map)
+    for nid in [0, 1, 2, 3]:
+        net.send_input(nid, Input.change(change))
+
+    def joined_and_committed(n):
+        j = n.node(4).protocol
+        if not j.joined:
+            return False
+        era1 = [b for b in batches_of(n, 4) if b.era == 1]
+        return len(era1) >= 1
+
+    drive_epochs(net, "tx", rounds=8, stop=joined_and_committed)
+
+    joiner = net.node(4).protocol
+    assert joiner.joined
+    # The joiner's era-1 batches match the validators' era-1 batches.
+    j_batches = {(b.era, b.epoch): b for b in batches_of(net, 4)}
+    v_batches = {(b.era, b.epoch): b for b in batches_of(net, 0)}
+    common = set(j_batches) & set(v_batches)
+    assert common, "no common era-1 batch committed"
+    for key in common:
+        assert j_batches[key].contributions == v_batches[key].contributions
+    assert net.correct_faults() == []
+    # Peers handed the plan exactly once each and now treat 4 as a peer.
+    sq0 = net.node(0).protocol
+    assert 4 in sq0._peers and 4 in sq0._join_plan_sent
+
+
+def test_deferred_removal_of_departing_validator():
+    """A removed validator still commits the change-complete batch
+    (its final era's messages keep flowing), and is dropped from peers
+    only after announcing the new era."""
+    net = build_sq_net(n=4, seed=73)
+    keep = dict(net.node(0).netinfo.public_key_map)
+    keep.pop(3)
+    change = Change.node_change(keep)
+    for nid in [0, 1, 2, 3]:
+        net.send_input(nid, Input.change(change))
+
+    def change_done_everywhere(n):
+        return all(
+            any(b.change.kind == "complete" for b in batches_of(n, i))
+            for i in [0, 1, 2, 3]
+        )
+
+    drive_epochs(net, "rm", rounds=8, stop=change_done_everywhere)
+
+    # Node 3 (departing) committed the change-complete batch of its era.
+    b3 = [b for b in batches_of(net, 3) if b.change.kind == "complete"]
+    assert b3, "departing validator missed the change-complete batch"
+    # Drive a little more so node 3's (1, 0) announcement is delivered
+    # and peers complete the deferred removal.
+    net.crank_until(
+        lambda n: all(3 not in n.node(i).protocol._peers for i in [0, 1, 2]),
+        max_cranks=200_000,
+    )
+    for i in [0, 1, 2]:
+        sq = net.node(i).protocol
+        assert 3 not in sq._peers
+        assert 3 not in sq._outbox
+        assert 3 not in sq._departing
+    assert net.correct_faults() == []
+    # era 1 still commits among the remaining three validators
+    for r in range(2):
+        for nid in [0, 1, 2]:
+            net.send_input(nid, Input.user(f"post-{r}-{nid}"))
+        net.crank_until(
+            lambda n, want=len(batches_of(net, 0)) + 1: all(
+                len(batches_of(n, i)) >= want for i in [0, 1, 2]
+            ),
+            max_cranks=200_000,
+        )
+    era1 = [b for b in batches_of(net, 0) if b.era == 1]
+    assert era1, "no post-removal batches committed"

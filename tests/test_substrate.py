@@ -32,7 +32,9 @@ def test_step_merge_and_map():
 
 
 def test_network_info_sizes():
-    ni = NetworkInfo(our_id=2, val_ids=range(10), public_key_set=None)
+    ni = NetworkInfo(
+        our_id=2, val_ids=range(10), public_key_set=None, secret_key_share=object()
+    )
     assert ni.num_nodes == 10
     assert ni.num_faulty == 3
     assert ni.num_correct == 7
@@ -41,3 +43,8 @@ def test_network_info_sizes():
     observer = NetworkInfo(our_id="obs", val_ids=range(4), public_key_set=None)
     assert not observer.is_validator()
     assert observer.num_faulty == 1
+    # Listed in the validator set but share-less (JoinPlan joiner whose
+    # DKG predates it): acts as observer, but peers still count it.
+    joiner = NetworkInfo(our_id=1, val_ids=range(4), public_key_set=None)
+    assert not joiner.is_validator()
+    assert joiner.is_node_validator(1)
