@@ -135,6 +135,7 @@ class VirtualNet:
         self.cranks = 0
         self.delivered = 0
         self._since_flush = 0
+        self._dirty_pools: set = set()
         self.metrics = Metrics()
 
     # -- introspection -------------------------------------------------
@@ -258,6 +259,8 @@ class VirtualNet:
             node.sent_messages += 1
             for dest in tm.target.recipients(all_ids, node.id):
                 self.queue.append(NetMessage(node.id, dest, tm.message))
+        if node.pool:
+            self._dirty_pools.add(node.id)
 
     def _maybe_flush(self) -> None:
         self._since_flush += 1
@@ -265,14 +268,23 @@ class VirtualNet:
             self._flush_all_pools()
 
     def _flush_all_pools(self) -> None:
+        """Flush nodes with pending verify requests, in sorted-id order.
+
+        Only *dirty* nodes are visited: a node's pool can only fill
+        while its own handler (or its own flush) runs, so the set of
+        non-empty pools is exactly the ids recorded by _process_step —
+        scanning every node per crank was the single hottest line of
+        the N=64 benchmark profile."""
         self._since_flush = 0
-        for nid in sorted(self.nodes):
-            node = self.nodes[nid]
-            while node.pool:
-                self.metrics.count("verify_requests", len(node.pool))
-                with self.metrics.timer("verify_flush"):
-                    step = node.pool.flush(self.backend)
-                self._process_step(node, step)
+        while self._dirty_pools:
+            for nid in sorted(self._dirty_pools):
+                self._dirty_pools.discard(nid)
+                node = self.nodes.get(nid)
+                while node is not None and node.pool:
+                    self.metrics.count("verify_requests", len(node.pool))
+                    with self.metrics.timer("verify_flush"):
+                        step = node.pool.flush(self.backend)
+                    self._process_step(node, step)
 
 
 class NetBuilder:
