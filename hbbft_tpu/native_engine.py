@@ -1,0 +1,407 @@
+"""Native (C++) protocol-plane engine: bindings + the engine-backed net.
+
+Reference behavior: the reference's consensus stack is native code end to
+end; ``native/engine.cpp`` is this framework's equivalent for the
+message-intensive layers (Broadcast, SBV/BA + coin, ThresholdDecrypt,
+Subset, the HoneyBadger epoch loop) over the scalar test suite, running
+a whole simulated network (the VirtualNet crank loop) inside one C++
+queue.  The per-BATCH layers stay in Python and are REUSED, not
+reimplemented: :class:`NativeDhb` subclasses the real
+``DynamicHoneyBadger`` (votes, DKG, era logic) and plugs an engine
+facade in place of its inner HoneyBadger; ``QueueingHoneyBadger`` runs
+unmodified on top.
+
+Fidelity: the engine commits byte-identical batches and fault logs to
+the pure-Python VirtualNet at the same seed (tests/test_native_engine.py
+pins this at several N).  Randomness stays in Python — the engine calls
+back / is called at exactly the points the Python stack would consume
+the node rng, so the streams match by construction.
+
+Scope: int node ids 0..N-1, ScalarSuite, no adversary (FIFO delivery,
+silent crash-faulty nodes), flush_every=1 (eager verification).  This is
+the protocol-plane benchmark configuration (BASELINE configs 3/4); real
+BLS + TPU-batched runs use the Python VirtualNet.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import random
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+from hbbft_tpu.crypto.pool import VerifySink
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger
+from hbbft_tpu.protocols.honey_badger import Batch, EncryptionSchedule
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
+from hbbft_tpu.protocols.traits import Step
+from hbbft_tpu.utils import canonical_bytes, serde
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "engine.cpp")
+_SO = os.path.join(_ROOT, "native", "build", "libhbbft_engine.so")
+
+_BATCH_CB = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32)
+_CONTRIB_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.c_int32,
+    ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_uint64,
+)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("HBBFT_TPU_NO_NATIVE"):
+        return None
+    def _mtime(path):
+        return os.path.getmtime(path) if os.path.exists(path) else 0.0
+
+    header = os.path.join(os.path.dirname(_SRC), "sha3_gf.h")
+    if not os.path.exists(_SO) or max(_mtime(_SRC), _mtime(header)) > os.path.getmtime(_SO):
+        try:
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            tmp = f"{_SO}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC],
+                check=True,
+                capture_output=True,
+                timeout=300,
+                cwd=os.path.dirname(_SRC),
+            )
+            os.replace(tmp, _SO)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.hbe_create.restype = ctypes.c_void_p
+    lib.hbe_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
+    lib.hbe_destroy.argtypes = [ctypes.c_void_p]
+    lib.hbe_set_callbacks.argtypes = [ctypes.c_void_p, _BATCH_CB, _CONTRIB_CB]
+    lib.hbe_set_silent.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    init_args = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, u8p, ctypes.c_uint64,
+        i32p, ctypes.c_int32, ctypes.c_int32, u8p, u8p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.hbe_init_node.argtypes = init_args
+    lib.hbe_restart_node.argtypes = init_args
+    lib.hbe_replay_era.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_propose.restype = ctypes.c_int32
+    lib.hbe_propose.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, u8p, ctypes.c_uint64,
+    ]
+    lib.hbe_run.restype = ctypes.c_uint64
+    lib.hbe_run.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.hbe_queue_len.restype = ctypes.c_uint64
+    lib.hbe_queue_len.argtypes = [ctypes.c_void_p]
+    lib.hbe_delivered.restype = ctypes.c_uint64
+    lib.hbe_delivered.argtypes = [ctypes.c_void_p]
+    for name in ("hbe_epoch", "hbe_era", "hbe_has_proposed"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int32
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_batch_size.restype = ctypes.c_int32
+    lib.hbe_batch_size.argtypes = [ctypes.c_void_p]
+    lib.hbe_batch_proposer.restype = ctypes.c_int32
+    lib.hbe_batch_proposer.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_batch_payload_len.restype = ctypes.c_uint64
+    lib.hbe_batch_payload_len.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_batch_payload.argtypes = [ctypes.c_void_p, ctypes.c_int32, u8p]
+    lib.hbe_fault_count.restype = ctypes.c_int32
+    lib.hbe_fault_count.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_fault_subject.restype = ctypes.c_int32
+    lib.hbe_fault_subject.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.hbe_fault_kind.restype = ctypes.c_char_p
+    lib.hbe_fault_kind.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    return lib
+
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOADED = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LOADED
+    if not _LOADED:
+        _LIB = _load()
+        _LOADED = True
+    return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_SCHED_KINDS = {"always": 0, "never": 1, "every_nth": 2, "tick_tock": 3}
+
+
+def _be32(x: int) -> bytes:
+    return int(x).to_bytes(32, "big")
+
+
+class _NullSink(VerifySink):
+    """DHB itself never submits verifications (vote signatures verify
+    inline); the engine handles everything below HB internally."""
+
+    def submit(self, req: Any, cb: Any) -> None:  # pragma: no cover
+        raise AssertionError("native DHB layer should not submit verifies")
+
+
+class EngineHb:
+    """Facade standing in for DynamicHoneyBadger's inner HoneyBadger.
+
+    Mirrors honey_badger.HoneyBadger._propose_now's data preparation
+    byte-for-byte (serde.dumps + threshold-encrypt with the node rng),
+    then hands the payload to the engine.
+    """
+
+    def __init__(self, net: "NativeQhbNet", node_id: int, era: int,
+                 netinfo: NetworkInfo, schedule: EncryptionSchedule) -> None:
+        self._net = net
+        self._node_id = node_id
+        self._era = era
+        self._netinfo = netinfo
+        self._schedule = schedule
+
+    @property
+    def epoch(self) -> int:
+        return self._net.lib.hbe_epoch(self._net.handle, self._node_id)
+
+    @property
+    def has_input(self) -> bool:
+        return bool(self._net.lib.hbe_has_proposed(self._net.handle, self._node_id))
+
+    def handle_input(self, input: Any, rng: Any) -> Step:
+        if not self._netinfo.is_validator():
+            return Step.empty()
+        if self.has_input:
+            raise AssertionError(
+                "engine HB cannot hold proposals; guard with has_input"
+            )
+        data = serde.dumps(input)
+        if self._schedule.encrypt_on(self.epoch):
+            pk = self._netinfo.public_key_set.public_key()
+            data = serde.dumps(pk.encrypt(data, rng))
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        accepted = self._net.lib.hbe_propose(
+            self._net.handle, self._node_id, self._era, buf, len(data)
+        )
+        assert accepted, "propose rejected (era/epoch mismatch)"
+        return Step.empty()
+
+    def handle_message(self, sender: Any, message: Any, rng: Any) -> Step:
+        raise AssertionError("messages are engine-internal")
+
+
+class NativeDhb(DynamicHoneyBadger):
+    """DynamicHoneyBadger whose inner HoneyBadger runs in the engine.
+
+    All vote / DKG / era logic is the REUSED parent implementation;
+    only _make_hb (engine node init / era restart) and _replay_next_era
+    (engine-buffered messages) differ.
+    """
+
+    def __init__(self, net: "NativeQhbNet", node_id: int,
+                 netinfo: NetworkInfo, **kwargs: Any) -> None:
+        self._net = net
+        self._node_id = node_id
+        self._engine_inited = False
+        super().__init__(netinfo, _NullSink(), **kwargs)
+
+    def _make_hb(self) -> EngineHb:
+        net, nid = self._net, self._node_id
+        netinfo = self._netinfo
+        session = canonical_bytes(self._session_id, self._era)
+        val_ids = list(netinfo.all_ids)
+        arr = (ctypes.c_int32 * len(val_ids))(*val_ids)
+        sk = netinfo.secret_key_share
+        sk_buf = (
+            (ctypes.c_uint8 * 32).from_buffer_copy(_be32(sk.x))
+            if sk is not None
+            else None
+        )
+        pk_flat = bytearray(32 * net.n)
+        for vid in val_ids:
+            pk_flat[32 * vid : 32 * (vid + 1)] = _be32(
+                netinfo.public_key_share(vid).g1.value
+            )
+        pk_buf = (ctypes.c_uint8 * len(pk_flat)).from_buffer_copy(bytes(pk_flat))
+        sess_buf = (ctypes.c_uint8 * len(session)).from_buffer_copy(session)
+        fn = net.lib.hbe_init_node if not self._engine_inited else net.lib.hbe_restart_node
+        fn(
+            net.handle, nid, self._era, sess_buf, len(session),
+            arr, len(val_ids), netinfo.num_faulty,
+            sk_buf, pk_buf, self.max_future_epochs,
+            _SCHED_KINDS[self.encryption_schedule.kind], self.encryption_schedule.n,
+        )
+        self._engine_inited = True
+        return EngineHb(net, nid, self._era, netinfo, self.encryption_schedule)
+
+    def _replay_next_era(self) -> Step:
+        self._net.lib.hbe_replay_era(self._net.handle, self._node_id)
+        return Step.empty()
+
+    def handle_message(self, sender: Any, message: Any, rng: Any) -> Step:
+        raise AssertionError("messages are engine-internal")
+
+
+class _NativeNode:
+    __slots__ = ("id", "qhb", "rng", "outputs", "contrib_cache")
+
+    def __init__(self, nid: int, qhb: QueueingHoneyBadger, rng: random.Random):
+        self.id = nid
+        self.qhb = qhb
+        self.rng = rng
+        self.outputs: List[DhbBatch] = []
+        self.contrib_cache: Dict[tuple, Any] = {}
+
+
+class NativeQhbNet:
+    """Engine-backed QueueingHoneyBadger network (NetBuilder-compatible
+    key generation and rng seeding, so runs are comparable to the
+    Python VirtualNet at the same seed)."""
+
+    def __init__(
+        self,
+        n: int,
+        seed: int = 0,
+        batch_size: int = 8,
+        num_faulty: Optional[int] = None,
+        session_id: bytes = b"qhb-test",
+        encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
+    ) -> None:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native engine unavailable (no compiler?)")
+        self.lib = lib
+        self.n = n
+        f = num_faulty if num_faulty is not None else (n - 1) // 3
+        assert 3 * f < n
+        self.f = f
+        suite = ScalarSuite()
+        rng = random.Random(seed)
+        sks = SecretKeySet.random(f, rng, suite)
+        pks = sks.public_keys()
+        node_sks = {i: SecretKey.random(rng, suite) for i in range(n)}
+        node_pks = {i: node_sks[i].public_key() for i in range(n)}
+        val_ids = list(range(n))
+        faulty = val_ids[n - f :] if f else []
+        self.faulty_ids = list(faulty)
+        self.correct_ids = [i for i in range(n) if i not in faulty]
+
+        self.handle = lib.hbe_create(n, f)
+        assert self.handle
+        # keep callback objects alive for the engine's lifetime
+        self._batch_cb = _BATCH_CB(self._on_batch)
+        self._contrib_cb = _CONTRIB_CB(self._on_contrib)
+        lib.hbe_set_callbacks(self.handle, self._batch_cb, self._contrib_cb)
+
+        self.nodes: Dict[int, _NativeNode] = {}
+        self._suite = suite
+        for i in range(n):
+            netinfo = NetworkInfo(
+                our_id=i,
+                val_ids=val_ids,
+                public_key_set=pks,
+                secret_key_share=sks.secret_key_share(i),
+                public_keys={j: node_pks[j] for j in val_ids},
+                secret_key=node_sks[i],
+            )
+            node_rng = random.Random((seed << 16) ^ (i + 1))
+            dhb = NativeDhb(
+                self, i, netinfo,
+                session_id=session_id,
+                encryption_schedule=encryption_schedule,
+            )
+            qhb = QueueingHoneyBadger(
+                netinfo, _NullSink(), batch_size=batch_size,
+                session_id=session_id, dhb=dhb,
+            )
+            self.nodes[i] = _NativeNode(i, qhb, node_rng)
+            if i in faulty:
+                lib.hbe_set_silent(self.handle, i, 1)
+
+    # -- engine callbacks ----------------------------------------------
+    def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
+        payload = bytes(bytearray(data[:length])) if length else b""
+        try:
+            obj = serde.loads(payload, suite=self._suite)
+        except serde.DecodeError:
+            return 0
+        self.nodes[node].contrib_cache[(era, epoch, proposer)] = obj
+        return 1
+
+    def _on_batch(self, node, era, epoch) -> None:
+        nd = self.nodes[node]
+        lib = self.lib
+        size = lib.hbe_batch_size(self.handle)
+        contribs = []
+        for i in range(size):
+            proposer = lib.hbe_batch_proposer(self.handle, i)
+            obj = nd.contrib_cache.pop((era, epoch, proposer), None)
+            contribs.append((proposer, obj))
+        batch = Batch(epoch, tuple(contribs))
+        dhb: NativeDhb = nd.qhb.dhb  # type: ignore[assignment]
+        dhb._rng = nd.rng
+        step = dhb._process_batch(batch)
+        step = nd.qhb._absorb(step, nd.rng)
+        nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
+
+    # -- driving --------------------------------------------------------
+    def send_input(self, nid: int, input: Any) -> None:
+        nd = self.nodes[nid]
+        if nid in self.faulty_ids:
+            return
+        step = nd.qhb.handle_input(input, nd.rng)
+        nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
+
+    def run(self, max_deliveries: int = 1 << 62) -> int:
+        return int(self.lib.hbe_run(self.handle, max_deliveries))
+
+    def run_until(self, pred: Callable[["NativeQhbNet"], bool],
+                  chunk: int = 50_000, max_total: int = 1 << 40) -> None:
+        total = 0
+        while not pred(self):
+            done = self.run(chunk)
+            total += done
+            if done == 0 and not pred(self):
+                raise RuntimeError("engine idle but condition not met")
+            if total > max_total:
+                raise RuntimeError("delivery limit exceeded")
+
+    @property
+    def delivered(self) -> int:
+        return int(self.lib.hbe_delivered(self.handle))
+
+    def faults(self, nid: int) -> List[tuple]:
+        out = []
+        for i in range(self.lib.hbe_fault_count(self.handle, nid)):
+            out.append(
+                (
+                    self.lib.hbe_fault_subject(self.handle, nid, i),
+                    self.lib.hbe_fault_kind(self.handle, nid, i).decode(),
+                )
+            )
+        return out
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.hbe_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

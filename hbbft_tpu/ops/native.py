@@ -29,9 +29,11 @@ _SO = os.path.join(_ROOT, "native", "build", "libhbbft_native.so")
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("HBBFT_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_SO) or (
-        os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-    ):
+    def _mtime(path):
+        return os.path.getmtime(path) if os.path.exists(path) else 0.0
+
+    header = os.path.join(os.path.dirname(_SRC), "sha3_gf.h")
+    if not os.path.exists(_SO) or max(_mtime(_SRC), _mtime(header)) > os.path.getmtime(_SO):
         try:
             os.makedirs(os.path.dirname(_SO), exist_ok=True)
             # Build to a process-unique temp path, then atomically rename:

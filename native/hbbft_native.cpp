@@ -24,80 +24,16 @@
 #include <utility>
 #include <vector>
 
+#include "sha3_gf.h"
+
 // --------------------------------------------------------------------------
-// Keccak-f[1600] / SHA3-256
+// Keccak-f[1600] / SHA3-256 (implementation shared via sha3_gf.h)
 // --------------------------------------------------------------------------
 
 namespace {
 
-const uint64_t RC[24] = {
-    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
-    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
-    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
-    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
-    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
-    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
-    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
-    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
-
-const int RHO[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10, 43,
-                     25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56, 14};
-
-inline uint64_t rotl64(uint64_t x, int r) {
-  return r ? (x << r) | (x >> (64 - r)) : x;
-}
-
-void keccak_f(uint64_t st[25]) {
-  for (int round = 0; round < 24; ++round) {
-    // theta
-    uint64_t c[5], d[5];
-    for (int x = 0; x < 5; ++x)
-      c[x] = st[x] ^ st[x + 5] ^ st[x + 10] ^ st[x + 15] ^ st[x + 20];
-    for (int x = 0; x < 5; ++x) {
-      d[x] = c[(x + 4) % 5] ^ rotl64(c[(x + 1) % 5], 1);
-      for (int y = 0; y < 5; ++y) st[x + 5 * y] ^= d[x];
-    }
-    // rho + pi
-    uint64_t b[25];
-    for (int x = 0; x < 5; ++x)
-      for (int y = 0; y < 5; ++y)
-        b[y + 5 * ((2 * x + 3 * y) % 5)] = rotl64(st[x + 5 * y], RHO[x + 5 * y]);
-    // chi
-    for (int y = 0; y < 5; ++y)
-      for (int x = 0; x < 5; ++x)
-        st[x + 5 * y] = b[x + 5 * y] ^ (~b[(x + 1) % 5 + 5 * y] & b[(x + 2) % 5 + 5 * y]);
-    // iota
-    st[0] ^= RC[round];
-  }
-}
-
-const size_t RATE = 136;  // SHA3-256
-
-void sha3_256_one(const uint8_t* in, size_t len, uint8_t* out32) {
-  uint64_t st[25];
-  std::memset(st, 0, sizeof(st));
-  uint8_t block[RATE];
-  while (len >= RATE) {
-    for (size_t i = 0; i < RATE / 8; ++i) {
-      uint64_t w;
-      std::memcpy(&w, in + 8 * i, 8);
-      st[i] ^= w;  // little-endian host assumed (x86-64 / aarch64)
-    }
-    keccak_f(st);
-    in += RATE;
-    len -= RATE;
-  }
-  std::memset(block, 0, RATE);
-  std::memcpy(block, in, len);
-  block[len] = 0x06;
-  block[RATE - 1] ^= 0x80;
-  for (size_t i = 0; i < RATE / 8; ++i) {
-    uint64_t w;
-    std::memcpy(&w, block + 8 * i, 8);
-    st[i] ^= w;
-  }
-  keccak_f(st);
-  std::memcpy(out32, st, 32);
+inline void sha3_256_one(const uint8_t* in, size_t len, uint8_t* out32) {
+  hbn::sha3_256(in, len, out32);
 }
 
 }  // namespace
@@ -161,95 +97,18 @@ void hb_merkle_levels(const uint8_t* leaves, uint64_t n_leaves,
 
 namespace {
 
-struct GfTables {
-  uint8_t exp[512];
-  int log[256];
-  // mul[a][b] flat table: one 64KB lookup beats exp/log chains in the
-  // row-accumulation inner loop.
-  uint8_t mul[256 * 256];
-  GfTables() {
-    int x = 1;
-    for (int i = 0; i < 255; ++i) {
-      exp[i] = static_cast<uint8_t>(x);
-      log[x] = i;
-      x <<= 1;
-      if (x & 0x100) x ^= 0x11d;
-    }
-    for (int i = 255; i < 510; ++i) exp[i] = exp[i - 255];
-    exp[510] = exp[511] = 0;
-    log[0] = 0;
-    for (int a = 0; a < 256; ++a)
-      for (int b = 0; b < 256; ++b)
-        mul[a * 256 + b] =
-            (a && b) ? exp[log[a] + log[b]] : 0;
-  }
-};
-
-const GfTables GF;
-
-inline uint8_t gf_mul(uint8_t a, uint8_t b) { return GF.mul[a * 256 + b]; }
-
-inline uint8_t gf_inv(uint8_t a) { return GF.exp[255 - GF.log[a]]; }
-
-// out[r][c] ^= sum over i of a[r][i]*b[i][c]  (dims m x k @ k x n)
-void gf_matmul(const uint8_t* a, const uint8_t* b, uint8_t* out, size_t m,
-               size_t k, size_t n) {
-  std::memset(out, 0, m * n);
-  for (size_t r = 0; r < m; ++r) {
-    for (size_t i = 0; i < k; ++i) {
-      uint8_t coef = a[r * k + i];
-      if (!coef) continue;
-      const uint8_t* row = b + i * n;
-      const uint8_t* tab = GF.mul + static_cast<size_t>(coef) * 256;
-      uint8_t* dst = out + r * n;
-      for (size_t c = 0; c < n; ++c) dst[c] ^= tab[row[c]];
-    }
-  }
+inline void gf_matmul(const uint8_t* a, const uint8_t* b, uint8_t* out,
+                      size_t m, size_t k, size_t n) {
+  hbn::gf_matmul(a, b, out, m, k, n);
 }
 
-// Gauss-Jordan inverse over GF(256); returns false if singular.
-bool gf_mat_inv(const uint8_t* m_in, uint8_t* inv_out, size_t n) {
-  std::vector<uint8_t> a(m_in, m_in + n * n);
-  std::vector<uint8_t> inv(n * n, 0);
-  for (size_t i = 0; i < n; ++i) inv[i * n + i] = 1;
-  for (size_t col = 0; col < n; ++col) {
-    size_t pivot = col;
-    while (pivot < n && !a[pivot * n + col]) ++pivot;
-    if (pivot == n) return false;
-    if (pivot != col) {
-      for (size_t j = 0; j < n; ++j) {
-        std::swap(a[col * n + j], a[pivot * n + j]);
-        std::swap(inv[col * n + j], inv[pivot * n + j]);
-      }
-    }
-    uint8_t pinv = gf_inv(a[col * n + col]);
-    for (size_t j = 0; j < n; ++j) {
-      a[col * n + j] = gf_mul(a[col * n + j], pinv);
-      inv[col * n + j] = gf_mul(inv[col * n + j], pinv);
-    }
-    for (size_t r = 0; r < n; ++r) {
-      uint8_t f = a[r * n + col];
-      if (r == col || !f) continue;
-      for (size_t j = 0; j < n; ++j) {
-        a[r * n + j] ^= gf_mul(a[col * n + j], f);
-        inv[r * n + j] ^= gf_mul(inv[col * n + j], f);
-      }
-    }
-  }
-  std::memcpy(inv_out, inv.data(), n * n);
-  return true;
+inline bool gf_mat_inv(const uint8_t* m_in, uint8_t* inv_out, size_t n) {
+  return hbn::gf_mat_inv_t<std::vector<uint8_t>>(m_in, inv_out, n);
 }
 
-// Systematic n x k encoding matrix, identical to gf256.encoding_matrix.
-bool encoding_matrix_uncached(size_t k, size_t n, std::vector<uint8_t>& out) {
-  std::vector<uint8_t> vand(n * k);
-  for (size_t i = 0; i < n; ++i)
-    for (size_t j = 0; j < k; ++j) vand[i * k + j] = GF.exp[(i * j) % 255];
-  std::vector<uint8_t> top_inv(k * k);
-  if (!gf_mat_inv(vand.data(), top_inv.data(), k)) return false;
-  out.resize(n * k);
-  gf_matmul(vand.data(), top_inv.data(), out.data(), n, k, k);
-  return true;
+inline bool encoding_matrix_uncached(size_t k, size_t n,
+                                     std::vector<uint8_t>& out) {
+  return hbn::encoding_matrix_t<std::vector<uint8_t>>(k, n, out);
 }
 
 // Per-(k, n) cache: Broadcast creates one codec per RBC instance but the
