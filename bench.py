@@ -7,17 +7,24 @@ one pairing equality on a CPU core — ~10^3 verifies/sec/core (BASELINE.md
 TPU path: N same-message shares RLC-collapsed into batched 128-bit scalar
 multiplications plus two pairings, all on device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is measured against this machine's own single-thread
-pure-Python-free CPU estimate; the reference publishes no numbers
-(BASELINE.json:13 "published": {}), so the CPU pairing-rate proxy
-(1000 verifies/sec, the literature figure for one core) is the anchor.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+``vs_baseline`` is measured against the literature CPU pairing rate
+(~1000 verifies/sec/core); the reference publishes no numbers
+(BASELINE.json:13 "published": {}).
+
+Relay hardening (the round-1 failure: BENCH_r01.json was a traceback —
+the axon TPU relay was down and ``import jax`` hung/raised): the TPU
+backend is probed in a SUBPROCESS with a bounded timeout and retries.
+If the chip is unreachable, the same kernel runs on the CPU platform at
+a reduced batch and the JSON line carries ``"device": "cpu-fallback"``
+plus an ``"error"`` note — always parseable, never a stack trace.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -25,25 +32,76 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from hbbft_tpu.utils.jaxcache import enable_cache
 
-enable_cache()
+PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 45
+PROBE_WAIT_S = 10
 
-import random
 
-from hbbft_tpu.crypto.backend import VerifyRequest
-from hbbft_tpu.crypto.bls.suite import BLSSuite
-from hbbft_tpu.crypto.keys import SecretKeySet
-from hbbft_tpu.crypto.tpu.backend import TpuBackend
+def emit(payload: dict, code: int = 0) -> None:
+    print(json.dumps(payload))
+    sys.exit(code)
 
-# Literature CPU rate for one-pairing-per-share verification on one core
-# (~0.5-1.5 ms/pairing; PAPERS.md arxiv 2302.00418). No published
-# reference numbers exist to compare against (BASELINE.json:13).
-CPU_BASELINE_VERIFIES_PER_SEC = 1000.0
+
+def probe_tpu() -> tuple[bool, str]:
+    """Can a fresh interpreter initialize the TPU backend?  Run out of
+    process: a dead relay makes ``jax.devices()`` HANG, which no
+    in-process guard can bound."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return False, "JAX_PLATFORMS=cpu requested"
+    last = ""
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; d = jax.devices(); "
+                    "print(d[0].platform, len(d))",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return True, r.stdout.strip()
+            last = (r.stderr or "backend init failed").strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {PROBE_TIMEOUT_S}s (relay down?)"
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(PROBE_WAIT_S)
+    return False, last
 
 
 def main() -> None:
-    # 2048 shares amortize the flush's fixed pairing cost well while
-    # keeping first-compile time (one shape bucket) tolerable.
-    n_shares = int(os.environ.get("BENCH_SHARES", "2048"))
+    tpu_ok, note = probe_tpu()
+    if not tpu_ok:
+        # CPU fallback: same kernel, small batch (a cold CPU compile or a
+        # big-batch CPU run of the 255-bit scans would blow any driver
+        # timeout; 64 shares keeps the whole fallback under ~5 min solo).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        n_shares = int(os.environ.get("BENCH_SHARES_FALLBACK", "64"))
+    else:
+        # 2048 shares amortize the flush's fixed pairing cost well while
+        # keeping first-compile time (one shape bucket) tolerable.
+        n_shares = int(os.environ.get("BENCH_SHARES", "2048"))
+
+    import jax
+
+    if not tpu_ok:
+        jax.config.update("jax_platforms", "cpu")
+    enable_cache()
+
+    import random
+
+    from hbbft_tpu.crypto.backend import VerifyRequest
+    from hbbft_tpu.crypto.bls.suite import BLSSuite
+    from hbbft_tpu.crypto.keys import SecretKeySet
+    from hbbft_tpu.crypto.tpu.backend import TpuBackend
+
+    # Literature CPU rate for one-pairing-per-share verification on one
+    # core (~0.5-1.5 ms/pairing; PAPERS.md arxiv 2302.00418).
+    cpu_baseline = 1000.0
+
     suite = BLSSuite()
     rng = random.Random(7)
     sks = SecretKeySet.random(2, rng, suite)
@@ -66,17 +124,66 @@ def main() -> None:
     assert all(results), "benchmark verification failed"
 
     rate = n_shares / dt
-    print(
-        json.dumps(
-            {
-                "metric": "bls_sig_share_verifies_per_sec_per_chip",
-                "value": round(rate, 2),
-                "unit": "verifies/sec",
-                "vs_baseline": round(rate / CPU_BASELINE_VERIFIES_PER_SEC, 3),
-            }
-        )
-    )
+    payload = {
+        "metric": "bls_sig_share_verifies_per_sec_per_chip",
+        "value": round(rate, 2),
+        "unit": "verifies/sec",
+        "vs_baseline": round(rate / cpu_baseline, 3),
+        "shares": n_shares,
+        "device": "tpu" if tpu_ok else "cpu-fallback",
+    }
+    if tpu_ok:
+        # Driver-visible Pallas-Keccak validation + throughput (the data
+        # plane's Merkle hashing rides this kernel on TPU; VERDICT round
+        # 1 weak #5 asked for a check the bench run executes).
+        try:
+            payload.update(_keccak_pallas_stats())
+        except Exception as e:
+            payload["keccak_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+    else:
+        payload["error"] = f"tpu unreachable: {note}"
+    emit(payload)
+
+
+def _keccak_pallas_stats() -> dict:
+    """Validate the Pallas Keccak kernel against hashlib and measure its
+    batched throughput on the chip."""
+    import hashlib
+
+    import numpy as np
+
+    from hbbft_tpu.ops.jaxops import keccak_pallas as kp
+
+    rng = np.random.default_rng(3)
+    n = int(os.environ.get("BENCH_KECCAK_BATCH", "16384"))
+    msgs = rng.integers(0, 256, size=(n, 65), dtype=np.uint8)
+    digests = kp.sha3_256_batch(msgs)  # compiles + runs on TPU
+    for i in (0, 1, n // 2, n - 1):
+        assert (
+            digests[i].tobytes() == hashlib.sha3_256(msgs[i].tobytes()).digest()
+        ), "pallas keccak mismatch vs hashlib"
+    t0 = time.perf_counter()
+    kp.sha3_256_batch(msgs)
+    dt = time.perf_counter() - t0
+    return {
+        "keccak_pallas_hashes_per_sec": round(n / dt, 1),
+        "keccak_pallas_checked": True,
+    }
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # never a bare traceback on stdout
+        emit(
+            {
+                "metric": "bls_sig_share_verifies_per_sec_per_chip",
+                "value": 0,
+                "unit": "verifies/sec",
+                "vs_baseline": 0,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            },
+            code=1,
+        )

@@ -28,6 +28,8 @@ def batches_of(net, nid):
 
 
 def main() -> None:
+    if os.environ.get("BENCH_NATIVE"):
+        return main_native()
     n = int(os.environ.get("BENCH_NODES", "64"))
     t0 = time.perf_counter()
     net = (
@@ -83,6 +85,66 @@ def main() -> None:
                 "era_change_wall_s": round(churn_s, 2),
                 "epochs_to_complete_change": epochs_after - epochs_before,
                 "delivered_msgs": net.delivered,
+            }
+        )
+    )
+
+
+def main_native() -> None:
+    """Same phases on the native C++ protocol engine (BENCH_NATIVE=1).
+
+    The engine is output-equivalent to the Python stack at the same seed
+    (tests/test_native_engine.py); this measures the native message loop
+    with the Python DHB/QHB batch layers on top."""
+    from hbbft_tpu import native_engine
+
+    n = int(os.environ.get("BENCH_NODES", "64"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "20000"))
+    t0 = time.perf_counter()
+    nat = native_engine.NativeQhbNet(
+        n, seed=4, batch_size=n, num_faulty=0, session_id=b"cfg4"
+    )
+    setup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for nid in nat.correct_ids:
+        nat.send_input(nid, Input.user(f"pre-{nid}"))
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+        chunk=chunk,
+    )
+    epoch_s = time.perf_counter() - t0
+    epochs_before = max(len(nat.nodes[i].outputs) for i in nat.correct_ids)
+
+    victim = n - 1
+    ni = nat.nodes[0].qhb.dhb.netinfo
+    new_map = {i: ni.public_key(i) for i in ni.all_ids if i != victim}
+    t0 = time.perf_counter()
+    for nid in nat.correct_ids:
+        nat.send_input(nid, Input.change(Change.node_change(new_map)))
+        nat.send_input(nid, Input.user(f"churn-{nid}"))
+    nat.run_until(
+        lambda e: all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        ),
+        chunk=chunk,
+    )
+    churn_s = time.perf_counter() - t0
+    epochs_after = max(len(nat.nodes[i].outputs) for i in nat.correct_ids)
+    assert not nat.nodes[victim].qhb.dhb.netinfo.is_validator()
+
+    print(
+        json.dumps(
+            {
+                "config": "dynamic_hb_64node_churn",
+                "engine": "native",
+                "nodes": n,
+                "keygen_setup_s": round(setup_s, 2),
+                "plain_epoch_wall_s": round(epoch_s, 2),
+                "era_change_wall_s": round(churn_s, 2),
+                "epochs_to_complete_change": epochs_after - epochs_before,
+                "delivered_msgs": nat.delivered,
             }
         )
     )

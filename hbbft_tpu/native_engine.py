@@ -69,7 +69,7 @@ def _load() -> Optional[ctypes.CDLL]:
             os.makedirs(os.path.dirname(_SO), exist_ok=True)
             tmp = f"{_SO}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC],
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC],
                 check=True,
                 capture_output=True,
                 timeout=300,
@@ -144,6 +144,7 @@ def available() -> bool:
 
 
 _SCHED_KINDS = {"always": 0, "never": 1, "every_nth": 2, "tick_tock": 3}
+_DECODE_FAILED = object()
 
 
 def _be32(x: int) -> bytes:
@@ -309,6 +310,11 @@ class NativeQhbNet:
 
         self.nodes: Dict[int, _NativeNode] = {}
         self._suite = suite
+        # Committed payload bytes are identical across all N nodes; decode
+        # once per distinct payload instead of once per node.  Decoded
+        # contributions are treated as immutable by every consumer (QHB
+        # absorb, DHB batch processing), so sharing is safe.
+        self._decode_cache: Dict[bytes, Any] = {}
         for i in range(n):
             netinfo = NetworkInfo(
                 our_id=i,
@@ -335,10 +341,17 @@ class NativeQhbNet:
     # -- engine callbacks ----------------------------------------------
     def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
         payload = bytes(bytearray(data[:length])) if length else b""
-        try:
-            obj = serde.loads(payload, suite=self._suite)
-        except serde.DecodeError:
-            return 0
+        if payload in self._decode_cache:
+            obj = self._decode_cache[payload]
+            if obj is _DECODE_FAILED:
+                return 0
+        else:
+            try:
+                obj = serde.loads(payload, suite=self._suite)
+            except serde.DecodeError:
+                self._decode_cache[payload] = _DECODE_FAILED
+                return 0
+            self._decode_cache[payload] = obj
         self.nodes[node].contrib_cache[(era, epoch, proposer)] = obj
         return 1
 

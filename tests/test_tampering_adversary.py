@@ -145,3 +145,46 @@ def test_tampering_actually_tampers():
     net.broadcast_input(lambda nid: None)
     net.run_to_termination()
     assert adv.tampered_count > 0
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_replay_adversary_on_honey_badger(seed):
+    """RandomAdversary with replay enabled, across seeds and a deeper
+    stack than the single monkeypatched ThresholdSign run (VERDICT round
+    1, weak #8): replayed duplicates must neither break agreement nor
+    get correct nodes faulted."""
+    from hbbft_tpu.net import NetBuilder, RandomAdversary
+
+    net = (
+        NetBuilder(4, seed=seed)
+        .num_faulty(1)
+        .protocol(lambda ni, sink, rng: HoneyBadger(ni, sink))
+        .adversary(RandomAdversary(replay_p=0.4))
+        .build()
+    )
+    net.broadcast_input(lambda nid: [f"rp-{nid}"])
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= 1 for i in n.correct_ids),
+        max_cranks=400_000,
+    )
+    batches = [net.node(i).outputs[0] for i in net.correct_ids]
+    assert all(b == batches[0] for b in batches)
+    assert net.correct_faults() == []
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_replay_adversary_on_threshold_sign(seed):
+    from hbbft_tpu.net import NetBuilder, RandomAdversary
+
+    net = (
+        NetBuilder(7, seed=seed)
+        .protocol(lambda ni, sink, rng: ThresholdSign(ni, b"rp-doc", sink))
+        .adversary(RandomAdversary(replay_p=0.5))
+        .build()
+    )
+    net.broadcast_input(lambda nid: None)
+    net.run_to_termination()
+    outs = [net.node(i).outputs[0] for i in net.correct_ids]
+    assert all(o == outs[0] for o in outs)
+    assert net.correct_faults() == []

