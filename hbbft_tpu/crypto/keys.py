@@ -173,7 +173,16 @@ class Ciphertext:
         return self.suite.pairing_eq(self.suite.g1_generator(), self.w, self.u, h)
 
     def to_bytes(self) -> bytes:
-        return canonical_bytes(b"ciphertext", self.u.to_bytes(), self.v, self.w.to_bytes())
+        # Memoized: DKG signature payloads serialize the same ciphertext
+        # once per receiving node per message otherwise (N^2-hot at
+        # churn; pure function of frozen fields, so caching is safe).
+        cached = self.__dict__.get("_bytes")
+        if cached is None:
+            cached = canonical_bytes(
+                b"ciphertext", self.u.to_bytes(), self.v, self.w.to_bytes()
+            )
+            object.__setattr__(self, "_bytes", cached)
+        return cached
 
 
 class SecretKeySet:

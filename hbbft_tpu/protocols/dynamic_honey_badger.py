@@ -160,16 +160,27 @@ class SignedKeyGenMsg:
 
 
 def _kg_payload_bytes(payload: Any) -> bytes:
-    """Canonical (collision-free) bytes of a Part/Ack for signing."""
+    """Canonical (collision-free) bytes of a Part/Ack for signing.
+
+    Memoized on the (frozen) payload object: every node recomputes this
+    for every committed key-gen message otherwise — with shared decoded
+    objects that is N^2 serializations of multi-kilobyte Parts per
+    churn epoch."""
+    cached = payload.__dict__.get("_kg_bytes") if hasattr(payload, "__dict__") else None
+    if cached is not None:
+        return cached
     if isinstance(payload, Part):
-        return canonical_bytes(
+        out = canonical_bytes(
             b"part", payload.commitment.to_bytes(), *[c.to_bytes() for c in payload.rows]
         )
-    if isinstance(payload, Ack):
-        return canonical_bytes(
+    elif isinstance(payload, Ack):
+        out = canonical_bytes(
             b"ack", str(payload.proposer), *[c.to_bytes() for c in payload.values]
         )
-    raise TypeError(f"not a key-gen payload: {type(payload)!r}")
+    else:
+        raise TypeError(f"not a key-gen payload: {type(payload)!r}")
+    object.__setattr__(payload, "_kg_bytes", out)
+    return out
 
 
 @dataclass(frozen=True)

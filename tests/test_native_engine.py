@@ -185,3 +185,52 @@ def test_native_determinism():
 
     a, b = run_once(), run_once()
     assert a == b
+
+
+@pytest.mark.parametrize("sched_kind", ["never", "tick_tock"])
+def test_equivalence_encryption_schedules(sched_kind):
+    """Plaintext epochs take the _accept_plaintext fast path (no
+    ThresholdDecrypt); tick_tock alternates both paths."""
+    from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+
+    sched = (
+        EncryptionSchedule.never()
+        if sched_kind == "never"
+        else EncryptionSchedule.tick_tock(1)
+    )
+    pynet = (
+        NetBuilder(4, seed=41)
+        .num_faulty(0)
+        .max_cranks(10_000_000)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni,
+                sink,
+                batch_size=BATCH_SIZE,
+                session_id=SESSION,
+                encryption_schedule=sched,
+            )
+        )
+        .build()
+    )
+    nat = native_engine.NativeQhbNet(
+        4,
+        seed=41,
+        batch_size=BATCH_SIZE,
+        num_faulty=0,
+        session_id=SESSION,
+        encryption_schedule=sched,
+    )
+    for k in range(3):
+        for nid in range(4):
+            pynet.send_input(nid, Input.user(f"s{k}-{nid}"))
+            nat.send_input(nid, Input.user(f"s{k}-{nid}"))
+    pynet.crank_until(
+        lambda net: all(len(py_batches(net, i)) >= 3 for i in net.correct_ids),
+        max_cranks=10_000_000,
+    )
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 3 for i in e.correct_ids),
+        chunk=1,
+    )
+    assert_equivalent(pynet, nat)
