@@ -120,10 +120,20 @@ class Commitment:
         return len(self.elems) - 1
 
     def eval(self, x: int) -> Any:
-        """The committed value of f(x) in the group (Horner)."""
+        """The committed value of f(x) in the group (Horner), memoized
+        per ``x`` (row commitments are shared across nodes in the DKG
+        ack checks; see BivarCommitment.row)."""
+        cache = self.__dict__.get("_eval_cache")
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_eval_cache", cache)
+        hit = cache.get(x)
+        if hit is not None:
+            return hit
         acc = None
         for e in reversed(self.elems):
             acc = e if acc is None else acc * x + e
+        cache[x] = acc
         return acc
 
     def __add__(self, other: "Commitment") -> "Commitment":
@@ -213,7 +223,18 @@ class BivarCommitment:
         return acc
 
     def row(self, x: int) -> Commitment:
-        """Commitment to the univariate row poly ``y -> p(x, y)``."""
+        """Commitment to the univariate row poly ``y -> p(x, y)``.
+
+        Memoized per ``x`` on the object: during DKG every acker's row
+        is evaluated against the same (shared, immutable) commitment by
+        every node — N^3-hot at churn without the cache."""
+        cache = self.__dict__.get("_row_cache")
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_row_cache", cache)
+        hit = cache.get(x)
+        if hit is not None:
+            return hit
         n = len(self.elems)
         out = []
         for j in range(n):
@@ -222,7 +243,9 @@ class BivarCommitment:
                 e = self.elems[i][j]
                 acc = e if acc is None else acc * x + e
             out.append(acc)
-        return Commitment(tuple(out))
+        result = Commitment(tuple(out))
+        cache[x] = result
+        return result
 
     def to_bytes(self) -> bytes:
         from hbbft_tpu.utils import canonical_bytes

@@ -307,10 +307,32 @@ class SyncKeyGen:
         return pk_set, SecretKeyShare(secret, self.suite)
 
     # -- internals -----------------------------------------------------
+    def _shape_memo_key(self) -> tuple:
+        # The verdict depends only on public data + these parameters, so
+        # it can be cached on the (shared, immutable) message object —
+        # at churn every node re-validates the same decoded Part/Ack
+        # otherwise (N^3 ciphertext checks network-wide).
+        return (self.threshold, len(self._ids), self.suite.name)
+
     def _part_shape_ok(self, part: Any) -> bool:
         """Public structural validation (fields may be arbitrary objects)."""
         from hbbft_tpu.crypto.backend import _ciphertext_well_formed
 
+        key = self._shape_memo_key()
+        try:
+            cached = part.__dict__.get("_shape_ok")
+            if cached is not None and cached[0] == key:
+                return cached[1]
+        except Exception:
+            cached = None
+        ok = self._part_shape_ok_uncached(part, _ciphertext_well_formed)
+        try:
+            object.__setattr__(part, "_shape_ok", (key, ok))
+        except Exception:
+            pass
+        return ok
+
+    def _part_shape_ok_uncached(self, part: Any, _ciphertext_well_formed) -> bool:
         try:
             n1 = self.threshold + 1
             return (
@@ -334,6 +356,21 @@ class SyncKeyGen:
     def _ack_shape_ok(self, ack: Any) -> bool:
         from hbbft_tpu.crypto.backend import _ciphertext_well_formed
 
+        key = self._shape_memo_key()
+        try:
+            cached = ack.__dict__.get("_shape_ok")
+            if cached is not None and cached[0] == key:
+                return cached[1]
+        except Exception:
+            cached = None
+        ok = self._ack_shape_ok_uncached(ack, _ciphertext_well_formed)
+        try:
+            object.__setattr__(ack, "_shape_ok", (key, ok))
+        except Exception:
+            pass
+        return ok
+
+    def _ack_shape_ok_uncached(self, ack: Any, _ciphertext_well_formed) -> bool:
         try:
             return (
                 isinstance(ack, Ack)
