@@ -165,12 +165,27 @@ class Ciphertext:
     suite: Suite
 
     def hash_input(self) -> bytes:
-        return _ciphertext_hash_input(self.u, self.v)
+        cached = self.__dict__.get("_hash_input")
+        if cached is None:
+            cached = _ciphertext_hash_input(self.u, self.v)
+            object.__setattr__(self, "_hash_input", cached)
+        return cached
 
     def verify(self) -> bool:
-        """Ciphertext validity: ``e(G1, W) == e(U, H2(U||V))``."""
-        h = self.suite.hash_to_g2(self.hash_input())
-        return self.suite.pairing_eq(self.suite.g1_generator(), self.w, self.u, h)
+        """Ciphertext validity: ``e(G1, W) == e(U, H2(U||V))``.
+
+        Memoized: validity is a pure function of the frozen fields, and
+        ``SecretKey.decrypt`` re-verifies per decryptor — every node
+        decrypting its slot of a shared DKG ciphertext otherwise pays
+        the hash + pairing again."""
+        cached = self.__dict__.get("_verify_ok")
+        if cached is None:
+            h = self.suite.hash_to_g2(self.hash_input())
+            cached = self.suite.pairing_eq(
+                self.suite.g1_generator(), self.w, self.u, h
+            )
+            object.__setattr__(self, "_verify_ok", cached)
+        return cached
 
     def to_bytes(self) -> bytes:
         # Memoized: DKG signature payloads serialize the same ciphertext
