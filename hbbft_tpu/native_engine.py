@@ -28,7 +28,6 @@ from __future__ import annotations
 import ctypes
 import os
 import random
-import subprocess
 from typing import Any, Callable, Dict, List, Optional
 
 from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
@@ -58,29 +57,10 @@ _CONTRIB_CB = ctypes.CFUNCTYPE(
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    if os.environ.get("HBBFT_TPU_NO_NATIVE"):
-        return None
-    def _mtime(path):
-        return os.path.getmtime(path) if os.path.exists(path) else 0.0
+    from hbbft_tpu.ops.native import build_and_load
 
-    header = os.path.join(os.path.dirname(_SRC), "sha3_gf.h")
-    if not os.path.exists(_SO) or max(_mtime(_SRC), _mtime(header)) > os.path.getmtime(_SO):
-        try:
-            os.makedirs(os.path.dirname(_SO), exist_ok=True)
-            tmp = f"{_SO}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC],
-                check=True,
-                capture_output=True,
-                timeout=300,
-                cwd=os.path.dirname(_SRC),
-            )
-            os.replace(tmp, _SO)
-        except Exception:
-            return None
-    try:
-        lib = ctypes.CDLL(_SO)
-    except OSError:
+    lib = build_and_load(_SRC, _SO)
+    if lib is None:
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i32p = ctypes.POINTER(ctypes.c_int32)

@@ -26,32 +26,45 @@ _SRC = os.path.join(_ROOT, "native", "hbbft_native.cpp")
 _SO = os.path.join(_ROOT, "native", "build", "libhbbft_native.so")
 
 
-def _load() -> Optional[ctypes.CDLL]:
+def build_and_load(src: str, so: str, timeout: int = 300) -> Optional[ctypes.CDLL]:
+    """Compile ``src`` into ``so`` if stale and dlopen it; None on any
+    failure (callers fall back to pure-Python paths).
+
+    Staleness tracks the source AND the shared sha3_gf.h header (both
+    native libraries include it; a header edit must rebuild both).  The
+    build lands in a process-unique temp path then atomically renames:
+    other processes may have the current .so mapped, and a concurrent
+    importer must never CDLL a half-written file.
+    """
     if os.environ.get("HBBFT_TPU_NO_NATIVE"):
         return None
-    def _mtime(path):
+
+    def _mtime(path: str) -> float:
         return os.path.getmtime(path) if os.path.exists(path) else 0.0
 
-    header = os.path.join(os.path.dirname(_SRC), "sha3_gf.h")
-    if not os.path.exists(_SO) or max(_mtime(_SRC), _mtime(header)) > os.path.getmtime(_SO):
+    header = os.path.join(os.path.dirname(src), "sha3_gf.h")
+    if not os.path.exists(so) or max(_mtime(src), _mtime(header)) > os.path.getmtime(so):
         try:
-            os.makedirs(os.path.dirname(_SO), exist_ok=True)
-            # Build to a process-unique temp path, then atomically rename:
-            # other processes may have the current .so mapped, and a
-            # concurrent importer must never CDLL a half-written file.
-            tmp = f"{_SO}.{os.getpid()}.tmp"
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            tmp = f"{so}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, _SRC],
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src],
                 check=True,
                 capture_output=True,
-                timeout=120,
+                timeout=timeout,
             )
-            os.replace(tmp, _SO)
+            os.replace(tmp, so)
         except Exception:
             return None
     try:
-        lib = ctypes.CDLL(_SO)
+        return ctypes.CDLL(so)
     except OSError:
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    lib = build_and_load(_SRC, _SO, timeout=120)
+    if lib is None:
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u64p = ctypes.POINTER(ctypes.c_uint64)

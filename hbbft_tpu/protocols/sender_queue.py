@@ -237,9 +237,24 @@ class SenderQueue(ConsensusProtocol):
         added = new_ids - self._validator_ids
         removed = self._validator_ids - new_ids
         self._validator_ids = new_ids
+        # Era expiry for departing peers that never announced (crashed
+        # before observing their removal): once a LATER era completes,
+        # they have missed a whole era — stop serving them, else their
+        # outbox grows without bound for the lifetime of the network.
+        for peer, dep_era in list(self._departing.items()):
+            if dep_era < plan.era:
+                self._departing.pop(peer, None)
+                self._peer_epochs.pop(peer, None)
+                self._outbox.pop(peer, None)
+                if peer in self._peers:
+                    self._peers.remove(peer)
+                self._removed.add(peer)
         for peer in removed:
             if peer != self.our_id and peer in self._peer_epochs:
                 self._departing[peer] = plan.era
+            # A removed validator re-added by a LATER change must get
+            # that change's JoinPlan again.
+            self._join_plan_sent.discard(peer)
         for peer in sorted(added, key=str):
             if peer == self.our_id:
                 continue

@@ -306,7 +306,10 @@ def _unpack_join_plan(f: tuple) -> JoinPlan:
         all(suite.is_g1(e, check_subgroup=False) for e in commitment.elems),
         "JoinPlan: commitment elements not in suite G1",
     )
-    _need(type(validators) is tuple, "JoinPlan: bad validators")
+    _need(
+        type(validators) is tuple and len(validators) >= 1,
+        "JoinPlan: empty validator set",  # (0-1)//3 thresholds go negative
+    )
     for pair in validators:
         _need(type(pair) is tuple and len(pair) == 2, "JoinPlan: bad pair")
         _node_id(pair[0], "JoinPlan validator id")

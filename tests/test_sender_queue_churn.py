@@ -32,7 +32,11 @@ def build_sq_net(n=4, seed=0, batch_size=8):
         )
 
     return (
-        NetBuilder(n, seed=seed).num_faulty(0).protocol(factory).build()
+        NetBuilder(n, seed=seed)
+        .num_faulty(0)
+        .max_cranks(10_000_000)
+        .protocol(factory)
+        .build()
     )
 
 
@@ -41,19 +45,24 @@ def batches_of(net, nid):
 
 
 def drive_epochs(net, txn_prefix, rounds=6, stop=None):
+    def sq_ids(n):
+        return [
+            i for i in n.correct_ids if isinstance(n.node(i).protocol, SenderQueue)
+        ]
+
     for r in range(rounds):
         if stop is not None and stop(net):
             return
+        # target = one more batch than the slowest node currently has
+        # (absolute r+1 would be pre-satisfied after earlier phases)
+        base = min((len(batches_of(net, i)) for i in sq_ids(net)), default=0)
         for nid in sorted(net.nodes):
-            proto = net.node(nid).protocol
             net.send_input(nid, Input.user(f"{txn_prefix}-{r}-{nid}"))
         net.crank_until(
-            lambda n, want=r + 1: all(
-                len(batches_of(n, i)) >= want
-                for i in n.correct_ids
-                if isinstance(n.node(i).protocol, SenderQueue)
+            lambda n, want=base + 1: all(
+                len(batches_of(n, i)) >= want for i in sq_ids(n)
             ),
-            max_cranks=200_000,
+            max_cranks=400_000,
         )
     if stop is not None:
         assert stop(net), "condition not reached within driven epochs"
@@ -160,3 +169,71 @@ def test_deferred_removal_of_departing_validator():
         )
     era1 = [b for b in batches_of(net, 0) if b.era == 1]
     assert era1, "no post-removal batches committed"
+
+
+def test_removed_validator_rejoins_with_fresh_join_plan():
+    """A validator removed in one era and voted back in a LATER era must
+    receive the new era's JoinPlan (the sent-plans memo is cleared on
+    removal): its restarted JoiningSenderQueue joins and commits."""
+    net = build_sq_net(n=5, seed=77)
+    keep = dict(net.node(0).netinfo.public_key_map)
+    removed_pk = keep.pop(4)
+    for nid in [0, 1, 2, 3, 4]:
+        net.send_input(nid, Input.change(Change.node_change(keep)))
+
+    def change_done(n, era):
+        return all(
+            any(
+                b.change.kind == "complete" and b.era == era
+                for b in batches_of(n, i)
+            )
+            for i in [0, 1, 2, 3]
+        )
+
+    drive_epochs(net, "rm", rounds=8, stop=lambda n: change_done(n, 0))
+    # Node 4 announces era 1; peers complete its deferred removal.
+    net.crank_until(
+        lambda n: all(4 not in n.node(i).protocol._peers for i in [0, 1, 2, 3]),
+        max_cranks=400_000,
+    )
+
+    # "Process restart" of node 4: a fresh JoiningSenderQueue with only
+    # its long-term key (its old protocol state is gone).
+    sk4 = net.node(4).netinfo.secret_key
+    old_outputs = list(net.node(4).outputs)
+
+    def factory(sink, rng):
+        return JoiningSenderQueue(
+            4,
+            sk4,
+            sink,
+            peers=[0, 1, 2, 3],
+            make_inner=lambda plan, s: QueueingHoneyBadger.from_join_plan(
+                4, sk4, plan, s, batch_size=8, session_id=b"sq-churn"
+            ),
+        )
+
+    node4 = net.nodes.pop(4)
+    net.node_order = sorted(net.nodes) + sorted(net.faulty_ids)
+    net.add_node(4, factory)
+
+    # Vote node 4 back in (era 1 -> era 2).
+    back = dict(keep)
+    back[4] = removed_pk
+    for nid in [0, 1, 2, 3]:
+        net.send_input(nid, Input.change(Change.node_change(back)))
+    drive_epochs(net, "re", rounds=8, stop=lambda n: change_done(n, 1))
+
+    def rejoined(n):
+        j = n.node(4).protocol
+        return j.joined and any(b.era >= 2 for b in batches_of(n, 4))
+
+    drive_epochs(net, "post", rounds=8, stop=rejoined)
+    # The rejoined node's era-2 batches match the validators'.
+    j_batches = {(b.era, b.epoch): b for b in batches_of(net, 4) if b.era >= 2}
+    v_batches = {(b.era, b.epoch): b for b in batches_of(net, 0) if b.era >= 2}
+    common = set(j_batches) & set(v_batches)
+    assert common, "no common era-2 batch"
+    for key in common:
+        assert j_batches[key].contributions == v_batches[key].contributions
+    assert net.correct_faults() == []
