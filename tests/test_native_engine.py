@@ -234,3 +234,55 @@ def test_equivalence_encryption_schedules(sched_kind):
         chunk=1,
     )
     assert_equivalent(pynet, nat)
+
+
+def test_equivalence_era_change_n10():
+    """Deeper fidelity: a 10-node era change (f=3 silent faulty would
+    change correct_ids; keep all-correct) with per-delivery predicate
+    checks — several hundred thousand deliveries compared batch-for-batch."""
+    seed = 21
+    pynet = build_python_net(10, seed, f=0)
+    nat = native_engine.NativeQhbNet(
+        10, seed=seed, batch_size=10, num_faulty=0, session_id=SESSION
+    )
+    keep = dict(pynet.node(0).netinfo.public_key_map)
+    keep.pop(9)
+    change = Change.node_change(keep)
+    for nid in range(10):
+        pynet.send_input(nid, Input.change(change))
+        nat.send_input(nid, Input.change(change))
+
+    def py_done(net):
+        return all(
+            any(b.change.kind == "complete" for b in py_batches(net, i))
+            for i in net.correct_ids
+        )
+
+    def nat_done(e):
+        return all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        )
+
+    for r in range(8):
+        if py_done(pynet) and nat_done(nat):
+            break
+        for nid in range(10):
+            pynet.send_input(nid, Input.user(f"x{r}-{nid}"))
+            nat.send_input(nid, Input.user(f"x{r}-{nid}"))
+        want = r + 1
+        pynet.crank_until(
+            lambda net, w=want: all(
+                len(py_batches(net, i)) >= w for i in net.correct_ids
+            ),
+            max_cranks=10_000_000,
+        )
+        nat.run_until(
+            lambda e, w=want: all(
+                len(e.nodes[i].outputs) >= w for i in e.correct_ids
+            ),
+            chunk=1,
+        )
+    assert py_done(pynet) and nat_done(nat)
+    assert_equivalent(pynet, nat)
+    assert pynet.node(0).protocol.dhb.era == nat.nodes[0].qhb.dhb.era >= 1
