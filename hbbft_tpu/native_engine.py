@@ -72,7 +72,7 @@ def _load() -> Optional[ctypes.CDLL]:
     init_args = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, u8p, ctypes.c_uint64,
         i32p, ctypes.c_int32, ctypes.c_int32, u8p, u8p,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.hbe_init_node.argtypes = init_args
     lib.hbe_restart_node.argtypes = init_args
@@ -225,6 +225,7 @@ class NativeDhb(DynamicHoneyBadger):
             arr, len(val_ids), netinfo.num_faulty,
             sk_buf, pk_buf, self.max_future_epochs,
             _SCHED_KINDS[self.encryption_schedule.kind], self.encryption_schedule.n,
+            1 if self.subset_handling == "all_at_end" else 0,
         )
         self._engine_inited = True
         return EngineHb(net, nid, self._era, netinfo, self.encryption_schedule)
@@ -261,6 +262,7 @@ class NativeQhbNet:
         num_faulty: Optional[int] = None,
         session_id: bytes = b"qhb-test",
         encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
+        subset_handling: str = "incremental",
     ) -> None:
         lib = get_lib()
         if lib is None:
@@ -309,6 +311,7 @@ class NativeQhbNet:
                 self, i, netinfo,
                 session_id=session_id,
                 encryption_schedule=encryption_schedule,
+                subset_handling=subset_handling,
             )
             qhb = QueueingHoneyBadger(
                 netinfo, _NullSink(), batch_size=batch_size,

@@ -668,6 +668,7 @@ struct EpochState {
   bool proposed = false;
   bool batch_emitted = false;
   std::vector<SubsetOutItem> pending_outputs;
+  std::vector<std::pair<int, Bytes>> pending_payloads;  // all_at_end buffer
 };
 
 struct BatchData {
@@ -684,6 +685,8 @@ struct Hb {
   // EncryptionSchedule: kind 0 always, 1 never, 2 every_nth, 3 tick_tock
   int sched_kind = 0;
   int sched_n = 1;
+  // SubsetHandlingStrategy: 0 incremental, 1 all_at_end
+  int subset_handling = 0;
   std::unique_ptr<EpochState> state;
   std::map<int, std::vector<std::pair<int, EMsg>>> future;  // epoch -> msgs
   std::map<int, int> future_per_sender;
@@ -1946,10 +1949,19 @@ struct Ctx {
       SubsetOutItem out = st.pending_outputs[i];
       if (out.done) {
         st.subset_done = true;
+        // all_at_end: start every deferred decrypt now, in acceptance
+        // order (honey_badger._on_subset_output "done" branch).
+        std::vector<std::pair<int, Bytes>> pend;
+        pend.swap(st.pending_payloads);
+        for (auto& pv : pend) hb_start_decrypt(st, pv.first, pv.second);
         hb_try_batch(st);
       } else {
         st.accepted_order.push_back(out.proposer);
-        hb_start_decrypt(st, out.proposer, out.value);
+        if (node.hb->subset_handling == 1) {
+          st.pending_payloads.push_back({out.proposer, out.value});
+        } else {
+          hb_start_decrypt(st, out.proposer, out.value);
+        }
       }
     }
     st.pending_outputs.clear();
@@ -2196,7 +2208,8 @@ void hbe_init_node(void* h, int32_t node, int32_t era, const uint8_t* session,
                    uint64_t session_len, const int32_t* val_ids, int32_t n_val,
                    int32_t era_f, const uint8_t* sk_share,
                    const uint8_t* pk_shares, int32_t max_future_epochs,
-                   int32_t sched_kind, int32_t sched_n) {
+                   int32_t sched_kind, int32_t sched_n,
+                   int32_t subset_handling) {
   Engine* e = (Engine*)h;
   Node& nd = e->nodes[node];
   nd.era = era;
@@ -2216,6 +2229,7 @@ void hbe_init_node(void* h, int32_t node, int32_t era, const uint8_t* session,
   nd.hb->max_future_epochs = max_future_epochs;
   nd.hb->sched_kind = sched_kind;
   nd.hb->sched_n = sched_n;
+  nd.hb->subset_handling = subset_handling;
   Ctx ctx(*e, nd);
   nd.hb->state = ctx.hb_make_state(0);
 }
@@ -2228,9 +2242,10 @@ void hbe_restart_node(void* h, int32_t node, int32_t era,
                       const int32_t* val_ids, int32_t n_val, int32_t era_f,
                       const uint8_t* sk_share, const uint8_t* pk_shares,
                       int32_t max_future_epochs, int32_t sched_kind,
-                      int32_t sched_n) {
+                      int32_t sched_n, int32_t subset_handling) {
   hbe_init_node(h, node, era, session, session_len, val_ids, n_val, era_f,
-                sk_share, pk_shares, max_future_epochs, sched_kind, sched_n);
+                sk_share, pk_shares, max_future_epochs, sched_kind, sched_n,
+                subset_handling);
 }
 
 // Replay the buffered next-era messages (DynamicHoneyBadger's

@@ -286,3 +286,44 @@ def test_equivalence_era_change_n10():
     assert py_done(pynet) and nat_done(nat)
     assert_equivalent(pynet, nat)
     assert pynet.node(0).protocol.dhb.era == nat.nodes[0].qhb.dhb.era >= 1
+
+
+def test_equivalence_subset_handling_all_at_end():
+    """The engine honors SubsetHandlingStrategy: all_at_end defers every
+    decrypt until Subset completes, byte-identically to Python."""
+    pynet = (
+        NetBuilder(4, seed=47)
+        .num_faulty(0)
+        .max_cranks(10_000_000)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni,
+                sink,
+                batch_size=BATCH_SIZE,
+                session_id=SESSION,
+                subset_handling="all_at_end",
+            )
+        )
+        .build()
+    )
+    nat = native_engine.NativeQhbNet(
+        4,
+        seed=47,
+        batch_size=BATCH_SIZE,
+        num_faulty=0,
+        session_id=SESSION,
+        subset_handling="all_at_end",
+    )
+    for k in range(3):
+        for nid in range(4):
+            pynet.send_input(nid, Input.user(f"a{k}-{nid}"))
+            nat.send_input(nid, Input.user(f"a{k}-{nid}"))
+    pynet.crank_until(
+        lambda net: all(len(py_batches(net, i)) >= 3 for i in net.correct_ids),
+        max_cranks=10_000_000,
+    )
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 3 for i in e.correct_ids),
+        chunk=1,
+    )
+    assert_equivalent(pynet, nat)
