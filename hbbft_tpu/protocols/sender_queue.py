@@ -280,10 +280,10 @@ class JoiningSenderQueue(ConsensusProtocol):
     QHB-building factory for the queueing stack).  Messages arriving
     before the plan are buffered (bounded) and replayed after joining.
 
-    Trust note: the first structurally-valid JoinPlan wins.  As in the
-    reference, JoinPlan distribution is application-trusted — a
-    deployment should deliver it over an authenticated link or
-    cross-check plans from multiple peers.
+    Trust: ``join_quorum`` distinct peers must deliver value-identical
+    plans before joining (default 1 — first valid plan wins, the
+    reference's application-trusted stance; set it to f+1 so no
+    coalition of <= f Byzantine peers can feed a forged plan).
     """
 
     _MAX_BUFFER = 4096
@@ -297,6 +297,7 @@ class JoiningSenderQueue(ConsensusProtocol):
         make_inner: Optional[Callable[[Any, Any], ConsensusProtocol]] = None,
         max_future_epochs: int = 3,
         session_id: bytes = b"dhb",
+        join_quorum: int = 1,
     ) -> None:
         self._our_id = our_id
         self._secret_key = secret_key
@@ -305,6 +306,9 @@ class JoiningSenderQueue(ConsensusProtocol):
         self._max_future_epochs = max_future_epochs
         self._session_id = session_id
         self._make_inner = make_inner
+        self._join_quorum = max(1, join_quorum)
+        self._plan_votes: Dict[bytes, set] = {}
+        self._plan_by_digest: Dict[bytes, Any] = {}
         self._sq: Optional[SenderQueue] = None
         self._buffer: List[Tuple[Any, Any]] = []
 
@@ -342,9 +346,20 @@ class JoiningSenderQueue(ConsensusProtocol):
 
     def _join(self, plan: Any, sender: Any, rng: Any) -> Step:
         from hbbft_tpu.protocols.dynamic_honey_badger import JoinPlan
+        from hbbft_tpu.utils import serde
 
         if not isinstance(plan, JoinPlan):
             return Step.empty().fault(sender, FAULT_MALFORMED)
+        if self._join_quorum > 1:
+            try:
+                digest = serde.dumps(plan)
+            except serde.EncodeError:
+                return Step.empty().fault(sender, FAULT_MALFORMED)
+            self._plan_votes.setdefault(digest, set()).add(sender)
+            self._plan_by_digest[digest] = plan
+            if len(self._plan_votes[digest]) < self._join_quorum:
+                return Step.empty()
+            plan = self._plan_by_digest[digest]
 
         def default_make(p: Any, sink: Any) -> ConsensusProtocol:
             return DynamicHoneyBadger.from_join_plan(

@@ -327,3 +327,15 @@ def test_equivalence_subset_handling_all_at_end():
         chunk=1,
     )
     assert_equivalent(pynet, nat)
+
+
+@pytest.mark.parametrize("n,seed", [(4, 101), (5, 202), (7, 303), (6, 404)])
+def test_equivalence_fuzz(n, seed):
+    """Breadth: assorted (N, seed) combos, two epochs each, compared
+    batch-for-batch and fault-for-fault."""
+    f = (n - 1) // 3
+    steps = [("input", nid, Input.user(f"f{seed}-{nid}-{k}"), None)
+             for k in range(2) for nid in range(n - f)]
+    steps.append(("run_until_batches", None, 2, None))
+    pynet, nat = drive_pair(n, seed, f, steps)
+    assert_equivalent(pynet, nat)

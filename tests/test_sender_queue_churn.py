@@ -237,3 +237,64 @@ def test_removed_validator_rejoins_with_fresh_join_plan():
     for key in common:
         assert j_batches[key].contributions == v_batches[key].contributions
     assert net.correct_faults() == []
+
+
+def test_join_quorum_resists_forged_plan():
+    """join_quorum=2: one forged plan from a single (Byzantine) peer is
+    not enough; the node joins on the real plan once two peers deliver
+    matching copies, and commits with the network."""
+    net = build_sq_net(n=4, seed=79)
+    suite = ScalarSuite()
+    sk4 = SecretKey.random(random.Random(321), suite)
+    pk4 = sk4.public_key()
+
+    def joiner_factory(sink, rng):
+        return JoiningSenderQueue(
+            4,
+            sk4,
+            sink,
+            peers=[0, 1, 2, 3],
+            join_quorum=2,
+            make_inner=lambda plan, s: QueueingHoneyBadger.from_join_plan(
+                4, sk4, plan, s, batch_size=8, session_id=b"sq-churn"
+            ),
+        )
+
+    net.add_node(4, joiner_factory)
+
+    # A forged plan arrives first, from one "peer" only.
+    from hbbft_tpu.crypto.keys import SecretKeySet
+    from hbbft_tpu.net.virtual_net import NetMessage
+    from hbbft_tpu.protocols.dynamic_honey_badger import JoinPlan
+    from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+    from hbbft_tpu.protocols.sender_queue import SqMessage
+
+    forged_keys = SecretKeySet.random(1, random.Random(99), suite).public_keys()
+    forged = JoinPlan(
+        1,
+        forged_keys,
+        tuple(sorted({i: pk4 for i in range(4)}.items())),
+        EncryptionSchedule.always(),
+    )
+    net.inject(NetMessage(sender=2, dest=4, payload=SqMessage.join_plan(forged)))
+    while net.queue:
+        net.crank()
+    assert not net.node(4).protocol.joined  # one vote is not a quorum
+
+    # Legit era change: every peer sends the REAL plan -> quorum reached.
+    new_map = dict(net.node(0).netinfo.public_key_map)
+    new_map[4] = pk4
+    for nid in [0, 1, 2, 3]:
+        net.send_input(nid, Input.change(Change.node_change(new_map)))
+
+    def joined_and_committed(n):
+        j = n.node(4).protocol
+        return j.joined and any(b.era == 1 for b in batches_of(n, 4))
+
+    drive_epochs(net, "q", rounds=8, stop=joined_and_committed)
+    assert net.node(4).protocol.joined
+    # it joined on the REAL plan (its netinfo matches the validators')
+    real_pks = net.node(0).protocol.inner.dhb.netinfo.public_key_set
+    joined_pks = net.node(4).protocol.inner.dhb.netinfo.public_key_set
+    assert joined_pks == real_pks
+    assert joined_pks != forged_keys
