@@ -339,3 +339,55 @@ def test_equivalence_fuzz(n, seed):
     steps.append(("run_until_batches", None, 2, None))
     pynet, nat = drive_pair(n, seed, f, steps)
     assert_equivalent(pynet, nat)
+
+
+def test_equivalence_era_change_with_silent_faulty():
+    """Era change at N=7 with 2 silent crash-faulty validators: the
+    remaining 5 vote one of the FAULTY nodes out and both stacks restart
+    identically."""
+    seed = 31
+    pynet = build_python_net(7, seed, f=2)
+    nat = native_engine.NativeQhbNet(
+        7, seed=seed, batch_size=8, num_faulty=2, session_id=SESSION
+    )
+    assert pynet.correct_ids == nat.correct_ids == [0, 1, 2, 3, 4]
+    keep = dict(pynet.node(0).netinfo.public_key_map)
+    keep.pop(6)  # remove a faulty validator
+    change = Change.node_change(keep)
+    for nid in pynet.correct_ids:
+        pynet.send_input(nid, Input.change(change))
+        nat.send_input(nid, Input.change(change))
+
+    def py_done(net):
+        return all(
+            any(b.change.kind == "complete" for b in py_batches(net, i))
+            for i in net.correct_ids
+        )
+
+    def nat_done(e):
+        return all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        )
+
+    for r in range(10):
+        if py_done(pynet) and nat_done(nat):
+            break
+        for nid in pynet.correct_ids:
+            pynet.send_input(nid, Input.user(f"sf{r}-{nid}"))
+            nat.send_input(nid, Input.user(f"sf{r}-{nid}"))
+        want = r + 1
+        pynet.crank_until(
+            lambda net, w=want: all(
+                len(py_batches(net, i)) >= w for i in net.correct_ids
+            ),
+            max_cranks=10_000_000,
+        )
+        nat.run_until(
+            lambda e, w=want: all(
+                len(e.nodes[i].outputs) >= w for i in e.correct_ids
+            ),
+            chunk=1,
+        )
+    assert py_done(pynet) and nat_done(nat)
+    assert_equivalent(pynet, nat)
