@@ -307,6 +307,13 @@ class JoiningSenderQueue(ConsensusProtocol):
         self._session_id = session_id
         self._make_inner = make_inner
         self._join_quorum = max(1, join_quorum)
+        # One endorsed plan per configured peer: a peer re-sending a
+        # different plan replaces its previous vote, so at most
+        # len(peers) candidate plans are ever retained (Byzantine peers
+        # cannot grow memory with novel forged plans), and votes from
+        # senders outside the configured peer set never count toward
+        # the quorum.
+        self._plan_vote_by_peer: Dict[Any, bytes] = {}
         self._plan_votes: Dict[bytes, set] = {}
         self._plan_by_digest: Dict[bytes, Any] = {}
         self._sq: Optional[SenderQueue] = None
@@ -351,10 +358,23 @@ class JoiningSenderQueue(ConsensusProtocol):
         if not isinstance(plan, JoinPlan):
             return Step.empty().fault(sender, FAULT_MALFORMED)
         if self._join_quorum > 1:
+            if sender not in self._peers:
+                # Only configured peers vote: transport-level spoofing /
+                # unexpected senders must not weaken the f+1 quorum.
+                return Step.empty().fault(sender, FAULT_MALFORMED)
             try:
                 digest = serde.dumps(plan)
             except serde.EncodeError:
                 return Step.empty().fault(sender, FAULT_MALFORMED)
+            prev = self._plan_vote_by_peer.get(sender)
+            if prev is not None and prev != digest:
+                votes = self._plan_votes.get(prev)
+                if votes is not None:
+                    votes.discard(sender)
+                    if not votes:
+                        del self._plan_votes[prev]
+                        del self._plan_by_digest[prev]
+            self._plan_vote_by_peer[sender] = digest
             self._plan_votes.setdefault(digest, set()).add(sender)
             self._plan_by_digest[digest] = plan
             if len(self._plan_votes[digest]) < self._join_quorum:

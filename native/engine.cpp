@@ -478,7 +478,8 @@ struct EMsg {
   int32_t round = 0;     // BA round
   MsgType type = BA_BVAL;
   uint8_t bval = 0;  // bool for BVAL/AUX/TERM; BoolSet mask for CONF
-  U256 share = U256_ZERO;  // BA_COIN sig share / HB_DECRYPT share
+  U256 share = U256_ZERO;  // BA_COIN sig share / HB_DECRYPT share (scalar mode)
+  std::shared_ptr<const Bytes> share_b;  // same, external-crypto mode (opaque)
   std::shared_ptr<const ProofData> proof;  // BC_VALUE / BC_ECHO
   Root root{};                             // BC_READY / ECHO_HASH / CAN_DECODE
 };
@@ -554,9 +555,11 @@ struct Sbv {
 // ===========================================================================
 
 struct Ts {
-  U256 doc_h;  // hash_to_g2(doc)
+  U256 doc_h;  // hash_to_g2(doc) (scalar mode)
+  Bytes doc;   // the signed document (external-crypto mode: hashed Python-side)
   NodeSet seen;
-  std::vector<std::pair<int, U256>> verified;  // insertion order
+  std::vector<std::pair<int, U256>> verified;  // insertion order (scalar)
+  std::vector<std::pair<int, Bytes>> verified_b;  // same, external mode
   NodeSet verified_set;
   bool had_input = false;
   bool terminated = false;
@@ -571,10 +574,13 @@ struct Td {
   bool has_ct = false;
   ScalarCiphertext ct;
   U256 ct_h = U256_ZERO;  // hash_to_g2 of ct hash input
+  Bytes ct_payload;       // serde(Ciphertext) bytes (external-crypto mode)
   bool ct_valid = false;
   bool ciphertext_invalid = false;
-  std::vector<std::pair<int, U256>> buffered;  // arrival order
+  std::vector<std::pair<int, U256>> buffered;  // arrival order (scalar)
   std::vector<std::pair<int, U256>> verified;
+  std::vector<std::pair<int, Bytes>> buffered_b;  // same, external mode
+  std::vector<std::pair<int, Bytes>> verified_b;
   NodeSet verified_set;
   NodeSet seen;
   bool terminated = false;
@@ -705,8 +711,27 @@ struct Hb {
 // Node + Engine
 // ===========================================================================
 
+// One deferred verification (crypto.backend.VerifyRequest kinds).
+// External-crypto mode: the verdict comes from the Python verify-batch
+// callback at flush; scalar mode precomputes it at submission.
+enum VKind : uint8_t { VK_SIG = 0, VK_DEC = 1, VK_CT = 2 };
+
+struct VReq {
+  uint8_t kind = VK_SIG;
+  int32_t era = 0;
+  int32_t sender = -1;             // share sender (engine id); -1 for VK_CT
+  const Bytes* doc = nullptr;      // VK_SIG: signed document (owned by Ts,
+                                   // kept alive by the continuation's ref)
+  const Bytes* ct = nullptr;       // VK_DEC/VK_CT: serde ciphertext payload
+                                   // (owned by Td, kept alive likewise)
+  std::shared_ptr<const Bytes> share;  // VK_SIG/VK_DEC: wire share bytes
+};
+
 struct Pending {
-  std::function<void()> run;
+  bool need_verdict = false;  // true: external mode, verdict from flush cb
+  bool pre_ok = false;        // scalar mode: verdict computed at submit
+  VReq req;
+  std::function<void(bool)> run;
 };
 
 const int FUTURE_ERA_BUFFER = 4096;
@@ -734,6 +759,27 @@ typedef void (*BatchEventCb)(int32_t node, int32_t era, int32_t epoch);
 typedef int32_t (*ContribCb)(int32_t node, int32_t era, int32_t epoch,
                              int32_t proposer, const uint8_t* data,
                              uint64_t len);
+// External-crypto callbacks (all Python-side; see native_engine.py):
+//  - VerifyBatchCb: verdicts for the flushing node's pending requests,
+//    exposed during the call via hbe_vreq_* accessors; Python writes one
+//    byte per request into `verdicts`.
+//  - SignCb: kind 0 = threshold signature share over ctx (the doc);
+//    kind 1 = decryption share for ctx (serde ciphertext payload).
+//    Result returned through hbe_ret_bytes(ret, ...).
+//  - CombineCb: kind 0 = combine signature shares -> signature bytes;
+//    kind 1 = combine decryption shares -> plaintext bytes.  The t+1
+//    (index, share) pairs are exposed via hbe_comb_* accessors.
+//  - CtParseCb: serde.try_loads verdict for a subset-accepted payload
+//    (1 = decodes to a well-formed Ciphertext) — mirrors
+//    honey_badger._start_decrypt's decode gate.
+typedef void (*VerifyBatchCb)(int32_t node, int32_t count, uint8_t* verdicts);
+typedef void (*SignCb)(int32_t node, int32_t era, int32_t kind,
+                       const uint8_t* ctx, uint64_t ctx_len, void* ret);
+typedef void (*CombineCb)(int32_t node, int32_t era, int32_t kind,
+                          const uint8_t* ctx, uint64_t ctx_len, int32_t count,
+                          void* ret);
+typedef int32_t (*CtParseCb)(int32_t node, const uint8_t* payload,
+                             uint64_t len);
 
 struct Engine {
   int n = 0, f = 0;
@@ -746,7 +792,26 @@ struct Engine {
   // current batch exposed to Python during batch_cb
   std::vector<std::pair<int, Bytes>> cur_batch;  // str-sorted (proposer, payload)
   int depth = 0;  // >0 while inside a processing unit (nested entry points)
+  // -- external-crypto mode ------------------------------------------------
+  bool ext = false;
+  int flush_every = 1;  // 0 = flush only when the delivery queue runs dry
+  uint64_t since_flush = 0;
+  uint64_t pool_items = 0;  // total pending across all nodes
+  bool in_flush = false;
+  VerifyBatchCb verify_cb = nullptr;
+  SignCb sign_cb = nullptr;
+  CombineCb combine_cb = nullptr;
+  CtParseCb ct_parse_cb = nullptr;
+  // requests exposed to Python during verify_cb (pointers into the batch)
+  std::vector<const VReq*> cur_vreqs;
+  // (index, share bytes) pairs exposed during combine_cb
+  std::vector<std::pair<int32_t, const Bytes*>> cur_comb;
 };
+
+inline void pool_push(Engine& e, Node& node, Pending&& p) {
+  node.pool.push_back(std::move(p));
+  e.pool_items++;
+}
 
 // ===========================================================================
 // Engine mechanics: emission, faults, pool flush, merkle/RS helpers
@@ -889,32 +954,51 @@ struct Ctx {
   bool is_val(int id) const { return node.val_index[id] >= 0; }
 
   // ---- ThresholdSign (coin) ----------------------------------------------
+  //
+  // `parity_out` carries the coin value(s) of any signature combined in
+  // this call (Signature.parity()) — scalar mode computes the combine
+  // natively, external mode through the Python combine callback.
 
   void ts_input(EpochState& st, int proposer, Ba& ba, Ts& ts,
-                std::vector<U256>& sig_out) {
+                std::vector<uint8_t>& parity_out) {
     if (ts.had_input) return;
     ts.had_input = true;
     if (!node.has_share) return;
-    U256 share = mulmod(node.sk_share, ts.doc_h);
     EMsg m;
     m.era = node.era;
     m.epoch = st.epoch;
     m.proposer = proposer;
     m.round = ba.round;
     m.type = BA_COIN;
+    if (e.ext) {
+      auto share_b = std::make_shared<Bytes>();
+      e.sign_cb(node.id, node.era, 0, (const uint8_t*)ts.doc.data(),
+                ts.doc.size(), share_b.get());
+      m.share_b = share_b;
+      ops.broadcast(m);
+      if (!ts.terminated) {
+        ts.seen.add(node.id);
+        ts.verified_b.push_back({node.id, *share_b});
+        ts.verified_set.add(node.id);
+        ts_try_output(ts, parity_out);
+      }
+      return;
+    }
+    U256 share = mulmod(node.sk_share, ts.doc_h);
     m.share = share;
     ops.broadcast(m);
     if (!ts.terminated) {
       ts.seen.add(node.id);
       ts.verified.push_back({node.id, share});
       ts.verified_set.add(node.id);
-      ts_try_output(ts, sig_out);
+      ts_try_output(ts, parity_out);
     }
   }
 
   void ts_handle_share(EpochState& st, int proposer, Ba& ba,
-                       std::shared_ptr<Ts> ts, int sender, const U256& share,
-                       std::vector<U256>& sig_out) {
+                       std::shared_ptr<Ts> ts, int sender, const EMsg& m,
+                       std::vector<uint8_t>& parity_out) {
+    (void)parity_out;
     if (ts->terminated) return;
     if (!is_val(sender)) {
       ops.fault(sender, F_TS_NONVAL);
@@ -925,18 +1009,40 @@ struct Ctx {
       return;
     }
     ts->seen.add(sender);
-    // Deferred verification: compute the verdict now (order-independent
-    // scalar check), run the protocol callback at flush (pool order).
-    bool ok = share == mulmod(node.pk_shares[sender], ts->doc_h);
     int era = node.era, epoch = st.epoch, rnd = ba.round;
     Engine* eng = &e;
     Node* nd = &node;
-    node.pool.push_back({[eng, nd, era, epoch, proposer, rnd, ts, sender,
-                          share, ok]() {
-      Ctx c(*eng, *nd);
-      c.ts_verified_cb(era, epoch, proposer, rnd, ts, sender, share, ok);
-      c.commit_events();
-    }});
+    Pending p;
+    if (e.ext) {
+      std::shared_ptr<const Bytes> share_b =
+          m.share_b ? m.share_b : std::make_shared<const Bytes>();
+      p.need_verdict = true;
+      p.req.kind = VK_SIG;
+      p.req.era = era;
+      p.req.sender = sender;
+      p.req.doc = &ts->doc;  // Ts kept alive by the continuation below
+      p.req.share = share_b;
+      p.run = [eng, nd, era, epoch, proposer, rnd, ts, sender,
+               share_b](bool ok) {
+        Ctx c(*eng, *nd);
+        c.ts_verified_cb(era, epoch, proposer, rnd, ts, sender, U256_ZERO,
+                         share_b, ok);
+        c.commit_events();
+      };
+    } else {
+      // Deferred verification: compute the verdict now (order-independent
+      // scalar check), run the protocol callback at flush (pool order).
+      U256 share = m.share;
+      p.pre_ok = share == mulmod(node.pk_shares[sender], ts->doc_h);
+      p.run = [eng, nd, era, epoch, proposer, rnd, ts, sender,
+               share](bool ok) {
+        Ctx c(*eng, *nd);
+        c.ts_verified_cb(era, epoch, proposer, rnd, ts, sender, share,
+                         nullptr, ok);
+        c.commit_events();
+      };
+    }
+    pool_push(e, node, std::move(p));
   }
 
   // pool callback: TS._on_verified lifted through the coin-round /
@@ -944,18 +1050,21 @@ struct Ctx {
   // honey_badger._guard_epoch).
   void ts_verified_cb(int era, int epoch, int proposer, int rnd,
                       std::shared_ptr<Ts> ts, int sender, const U256& share,
-                      bool ok) {
+                      std::shared_ptr<const Bytes> share_b, bool ok) {
     bool live_epoch = node.era == era && node.hb && node.hb->epoch == epoch;
     if (!live_epoch) e.suppress_emit++;
-    std::vector<U256> sig_out;
+    std::vector<uint8_t> parity_out;
     // inner: TS._on_verified
     if (!ts->terminated) {
       if (!ok) {
         ops.fault(sender, F_TS_INVALID);
       } else {
-        ts->verified.push_back({sender, share});
+        if (e.ext)
+          ts->verified_b.push_back({sender, *share_b});
+        else
+          ts->verified.push_back({sender, share});
         ts->verified_set.add(sender);
-        ts_try_output(*ts, sig_out);
+        ts_try_output(*ts, parity_out);
       }
     }
     // lift: coin scope (round / BA termination / same instance), then the
@@ -963,10 +1072,10 @@ struct Ctx {
     // _guard_epoch(_on_subset_step) -> _advance in the Python chain).
     if (live_epoch) {
       EpochState& st = *node.hb->state;
-      if (!sig_out.empty()) {
+      if (!parity_out.empty()) {
         Ba& ba = *st.proposals[proposer].ba;
         if (ba.round == rnd && !ba.terminated && ba.coin == ts) {
-          for (const U256& sig : sig_out) ba_on_coin(st, proposer, ba, sig);
+          for (uint8_t par : parity_out) ba_on_coin(st, proposer, ba, par);
         }
       }
       hb_drain_subset_outputs(st);
@@ -975,9 +1084,30 @@ struct Ctx {
     if (!live_epoch) e.suppress_emit--;
   }
 
-  void ts_try_output(Ts& ts, std::vector<U256>& sig_out) {
+  void ts_try_output(Ts& ts, std::vector<uint8_t>& parity_out) {
     int threshold = f();
-    if (ts.terminated || (int)ts.verified.size() < threshold + 1) return;
+    size_t have = e.ext ? ts.verified_b.size() : ts.verified.size();
+    if (ts.terminated || (int)have < threshold + 1) return;
+    if (e.ext) {
+      // by_index -> sorted, first threshold+1, combine via Python.
+      std::vector<std::pair<int, const Bytes*>> by_index;
+      for (auto& kv : ts.verified_b)
+        by_index.push_back({node.val_index[kv.first], &kv.second});
+      std::sort(by_index.begin(), by_index.end(),
+                [](auto& a, auto& b) { return a.first < b.first; });
+      by_index.resize(threshold + 1);
+      e.cur_comb.clear();
+      for (auto& kv : by_index) e.cur_comb.push_back({kv.first, kv.second});
+      Bytes sig;
+      e.combine_cb(node.id, node.era, 0, (const uint8_t*)ts.doc.data(),
+                   ts.doc.size(), (int32_t)e.cur_comb.size(), &sig);
+      e.cur_comb.clear();
+      ts.terminated = true;
+      uint8_t digest[32];
+      hbn::sha3_256((const uint8_t*)sig.data(), sig.size(), digest);
+      parity_out.push_back(digest[0] & 1);
+      return;
+    }
     // by_index (netinfo.index) -> sorted, first threshold+1, combine.
     std::vector<std::pair<int, U256>> by_index;
     for (auto& kv : ts.verified)
@@ -993,7 +1123,7 @@ struct Ctx {
       acc = addmod(acc, mulmod(lam[i], by_index[i].second));
     ts.signature = acc;
     ts.terminated = true;
-    sig_out.push_back(acc);
+    parity_out.push_back(sig_parity(acc) ? 1 : 0);
   }
 
   // ---- SBV ----------------------------------------------------------------
@@ -1154,14 +1284,14 @@ struct Ctx {
     if (accepted_count < num_correct()) return;
     ba.coin_requested = true;
     ba.conf_vals = acc_union;
-    std::vector<U256> sig_out;
-    ts_input(st, proposer, ba, *ba.coin, sig_out);
-    for (const U256& sig : sig_out) ba_on_coin(st, proposer, ba, sig);
+    std::vector<uint8_t> parity_out;
+    ts_input(st, proposer, ba, *ba.coin, parity_out);
+    for (uint8_t par : parity_out) ba_on_coin(st, proposer, ba, par);
     ba_maybe_advance(st, proposer, ba);
   }
 
-  void ba_on_coin(EpochState& st, int proposer, Ba& ba, const U256& sig) {
-    ba.coin_value = sig_parity(sig) ? 1 : 0;
+  void ba_on_coin(EpochState& st, int proposer, Ba& ba, uint8_t parity) {
+    ba.coin_value = parity ? 1 : 0;
     ba_maybe_advance(st, proposer, ba);
   }
 
@@ -1299,9 +1429,9 @@ struct Ctx {
         ba_handle_conf(st, proposer, ba, sender, m.bval);
         break;
       case BA_COIN: {
-        std::vector<U256> sig_out;
-        ts_handle_share(st, proposer, ba, ba.coin, sender, m.share, sig_out);
-        for (const U256& sig : sig_out) ba_on_coin(st, proposer, ba, sig);
+        std::vector<uint8_t> parity_out;
+        ts_handle_share(st, proposer, ba, ba.coin, sender, m, parity_out);
+        for (uint8_t par : parity_out) ba_on_coin(st, proposer, ba, par);
         break;
       }
       default:
@@ -1768,11 +1898,37 @@ struct Ctx {
     int era = node.era, epoch = st.epoch;
     Engine* eng = &e;
     Node* nd = &node;
-    node.pool.push_back({[eng, nd, era, epoch, proposer, td, ok]() {
+    Pending p;
+    p.pre_ok = ok;
+    p.run = [eng, nd, era, epoch, proposer, td](bool ok2) {
+      Ctx c(*eng, *nd);
+      c.td_ct_checked_cb(era, epoch, proposer, td, ok2);
+      c.commit_events();
+    };
+    pool_push(e, node, std::move(p));
+  }
+
+  // External mode: the payload already passed the Python-side serde
+  // decode gate (ct_parse_cb); validity is a deferred VK_CT request.
+  void td_handle_input_ext(EpochState& st, int proposer,
+                           std::shared_ptr<Td> td, const Bytes& payload) {
+    if (td->has_ct || td->terminated) return;
+    td->has_ct = true;
+    td->ct_payload = payload;
+    int era = node.era, epoch = st.epoch;
+    Engine* eng = &e;
+    Node* nd = &node;
+    Pending p;
+    p.need_verdict = true;
+    p.req.kind = VK_CT;
+    p.req.era = era;
+    p.req.ct = &td->ct_payload;  // Td kept alive by the continuation
+    p.run = [eng, nd, era, epoch, proposer, td](bool ok) {
       Ctx c(*eng, *nd);
       c.td_ct_checked_cb(era, epoch, proposer, td, ok);
       c.commit_events();
-    }});
+    };
+    pool_push(e, node, std::move(p));
   }
 
   void td_ct_checked_cb(int era, int epoch, int proposer,
@@ -1788,22 +1944,38 @@ struct Ctx {
       } else {
         td->ct_valid = true;
         if (node.has_share) {
-          U256 share = mulmod(td->ct.u, node.sk_share);
-          td->seen.add(node.id);
-          td->verified.push_back({node.id, share});
-          td->verified_set.add(node.id);
           EMsg m;
           m.era = era;
           m.epoch = epoch;
           m.proposer = proposer;
           m.type = HB_DECRYPT;
-          m.share = share;
+          td->seen.add(node.id);
+          if (e.ext) {
+            auto share_b = std::make_shared<Bytes>();
+            e.sign_cb(node.id, era, 1, (const uint8_t*)td->ct_payload.data(),
+                      td->ct_payload.size(), share_b.get());
+            m.share_b = share_b;
+            td->verified_b.push_back({node.id, *share_b});
+          } else {
+            U256 share = mulmod(td->ct.u, node.sk_share);
+            m.share = share;
+            td->verified.push_back({node.id, share});
+          }
+          td->verified_set.add(node.id);
           ops.broadcast(m);
         }
-        std::vector<std::pair<int, U256>> buffered;
-        buffered.swap(td->buffered);
-        for (auto& kv : buffered)
-          td_submit_share(era, epoch, proposer, td, kv.first, kv.second);
+        if (e.ext) {
+          std::vector<std::pair<int, Bytes>> buffered;
+          buffered.swap(td->buffered_b);
+          for (auto& kv : buffered)
+            td_submit_share_ext(era, epoch, proposer, td, kv.first,
+                                std::make_shared<const Bytes>(std::move(kv.second)));
+        } else {
+          std::vector<std::pair<int, U256>> buffered;
+          buffered.swap(td->buffered);
+          for (auto& kv : buffered)
+            td_submit_share(era, epoch, proposer, td, kv.first, kv.second);
+        }
         td_try_output(*td, plain_out);
       }
     }
@@ -1819,16 +1991,39 @@ struct Ctx {
     bool ok = mulmod(share, td->ct_h) == mulmod(node.pk_shares[sender], td->ct.w);
     Engine* eng = &e;
     Node* nd = &node;
-    node.pool.push_back({[eng, nd, era, epoch, proposer, td, sender, share,
-                          ok]() {
+    Pending p;
+    p.pre_ok = ok;
+    p.run = [eng, nd, era, epoch, proposer, td, sender, share](bool ok2) {
       Ctx c(*eng, *nd);
-      c.td_verified_cb(era, epoch, proposer, td, sender, share, ok);
+      c.td_verified_cb(era, epoch, proposer, td, sender, share, nullptr, ok2);
       c.commit_events();
-    }});
+    };
+    pool_push(e, node, std::move(p));
+  }
+
+  void td_submit_share_ext(int era, int epoch, int proposer,
+                           std::shared_ptr<Td> td, int sender,
+                           std::shared_ptr<const Bytes> share_b) {
+    Engine* eng = &e;
+    Node* nd = &node;
+    Pending p;
+    p.need_verdict = true;
+    p.req.kind = VK_DEC;
+    p.req.era = era;
+    p.req.sender = sender;
+    p.req.ct = &td->ct_payload;
+    p.req.share = share_b;
+    p.run = [eng, nd, era, epoch, proposer, td, sender, share_b](bool ok) {
+      Ctx c(*eng, *nd);
+      c.td_verified_cb(era, epoch, proposer, td, sender, share_b, ok);
+      c.commit_events();
+    };
+    pool_push(e, node, std::move(p));
   }
 
   void td_verified_cb(int era, int epoch, int proposer, std::shared_ptr<Td> td,
-                      int sender, const U256& share, bool ok) {
+                      int sender, const U256& share,
+                      std::shared_ptr<const Bytes> share_b, bool ok) {
     bool live = node.era == era && node.hb && node.hb->epoch == epoch;
     if (!live) e.suppress_emit++;
     std::vector<Bytes> plain_out;
@@ -1836,7 +2031,10 @@ struct Ctx {
       if (!ok) {
         ops.fault(sender, F_TD_INVALID);
       } else {
-        td->verified.push_back({sender, share});
+        if (e.ext)
+          td->verified_b.push_back({sender, *share_b});
+        else
+          td->verified.push_back({sender, share});
         td->verified_set.add(sender);
         td_try_output(*td, plain_out);
       }
@@ -1848,8 +2046,14 @@ struct Ctx {
     if (!live) e.suppress_emit--;
   }
 
+  void td_verified_cb(int era, int epoch, int proposer, std::shared_ptr<Td> td,
+                      int sender, std::shared_ptr<const Bytes> share_b,
+                      bool ok) {
+    td_verified_cb(era, epoch, proposer, td, sender, U256_ZERO, share_b, ok);
+  }
+
   void td_handle_message(EpochState& st, int proposer, std::shared_ptr<Td> td,
-                         int sender, const U256& share) {
+                         int sender, const EMsg& m) {
     if (td->terminated) return;
     if (!is_val(sender)) {
       ops.fault(sender, F_TD_NONVAL);
@@ -1860,16 +2064,47 @@ struct Ctx {
       return;
     }
     td->seen.add(sender);
+    if (e.ext) {
+      std::shared_ptr<const Bytes> share_b =
+          m.share_b ? m.share_b : std::make_shared<const Bytes>();
+      if (td->ct_valid) {
+        td_submit_share_ext(node.era, st.epoch, proposer, td, sender, share_b);
+      } else {
+        td->buffered_b.push_back({sender, *share_b});
+      }
+      return;
+    }
     if (td->ct_valid) {
-      td_submit_share(node.era, st.epoch, proposer, td, sender, share);
+      td_submit_share(node.era, st.epoch, proposer, td, sender, m.share);
     } else {
-      td->buffered.push_back({sender, share});
+      td->buffered.push_back({sender, m.share});
     }
   }
 
   void td_try_output(Td& td, std::vector<Bytes>& plain_out) {
     int threshold = f();
-    if (td.terminated || (int)td.verified.size() < threshold + 1) return;
+    size_t have = e.ext ? td.verified_b.size() : td.verified.size();
+    if (td.terminated || (int)have < threshold + 1) return;
+    if (e.ext) {
+      std::vector<std::pair<int, const Bytes*>> by_index;
+      for (auto& kv : td.verified_b)
+        by_index.push_back({node.val_index[kv.first], &kv.second});
+      std::sort(by_index.begin(), by_index.end(),
+                [](auto& a, auto& b) { return a.first < b.first; });
+      by_index.resize(threshold + 1);
+      e.cur_comb.clear();
+      for (auto& kv : by_index) e.cur_comb.push_back({kv.first, kv.second});
+      Bytes plain;
+      e.combine_cb(node.id, node.era, 1,
+                   (const uint8_t*)td.ct_payload.data(), td.ct_payload.size(),
+                   (int32_t)e.cur_comb.size(), &plain);
+      e.cur_comb.clear();
+      td.plaintext = plain;
+      td.has_plaintext = true;
+      td.terminated = true;
+      plain_out.push_back(std::move(plain));
+      return;
+    }
     std::vector<std::pair<int, U256>> by_index;
     for (auto& kv : td.verified)
       by_index.push_back({node.val_index[kv.first], kv.second});
@@ -1972,6 +2207,23 @@ struct Ctx {
       hb_accept_plaintext(st, proposer, payload);
       return;
     }
+    if (e.ext) {
+      // serde decode verdict comes from Python (identical to
+      // honey_badger._start_decrypt's try_loads gate).
+      int ok = e.ct_parse_cb
+                   ? e.ct_parse_cb(node.id, (const uint8_t*)payload.data(),
+                                   payload.size())
+                   : 0;
+      if (!ok) {
+        st.faulty_proposers.add(proposer);
+        ops.fault(proposer, F_HB_BAD_CT);
+        hb_try_batch(st);
+        return;
+      }
+      auto td = hb_get_decrypt(st, proposer);
+      td_handle_input_ext(st, proposer, td, payload);
+      return;
+    }
     ScalarCiphertext ct;
     if (!decode_scalar_ciphertext((const uint8_t*)payload.data(),
                                   payload.size(), ct)) {
@@ -2019,6 +2271,7 @@ struct Ctx {
     canon_append(doc, ba.session_id);
     canon_append(doc, canon_int_bytes((uint64_t)ba.round));
     ts->doc_h = hash_to_g2(doc);
+    ts->doc = std::move(doc);  // external mode signs/verifies the raw doc
     ba.coin = ts;
   }
 
@@ -2059,7 +2312,7 @@ struct Ctx {
         return;
       }
       auto td = hb_get_decrypt(st, m.proposer);
-      td_handle_message(st, m.proposer, td, sender, m.share);
+      td_handle_message(st, m.proposer, td, sender, m);
       // _on_decrypt_step boundary: invalid-ct check after every td call.
       std::vector<Bytes> none;
       hb_on_decrypt_boundary(m.proposer, td, none);
@@ -2139,8 +2392,57 @@ void engine_flush_pool(Engine& e, Node& node) {
   while (!node.pool.empty()) {
     std::vector<Pending> items;
     items.swap(node.pool);
-    for (Pending& p : items) p.run();
+    e.pool_items -= items.size();
+    for (Pending& p : items) p.run(p.pre_ok);
   }
+}
+
+// External-crypto flush: mirrors VirtualNet._flush_all_pools — visit
+// nodes with pending requests in sorted-id order; per node, drain the
+// pool in rounds (one verify-batch callback per round, continuations in
+// submission order; continuations may refill the pool).
+void engine_flush_ext(Engine& e) {
+  if (e.in_flush) return;  // re-entrancy (a propose inside a batch cb)
+  e.in_flush = true;
+  e.since_flush = 0;
+  bool any = true;
+  while (any) {
+    any = false;
+    for (int nid = 0; nid < e.n; ++nid) {
+      Node& node = e.nodes[nid];
+      while (!node.pool.empty()) {
+        any = true;
+        std::vector<Pending> items;
+        items.swap(node.pool);
+        e.pool_items -= items.size();
+        std::vector<uint8_t> verdicts;
+        int need = 0;
+        for (Pending& p : items)
+          if (p.need_verdict) ++need;
+        if (need) {
+          e.cur_vreqs.clear();
+          for (Pending& p : items)
+            if (p.need_verdict) e.cur_vreqs.push_back(&p.req);
+          verdicts.assign(need, 0);
+          e.verify_cb(nid, need, verdicts.data());
+          e.cur_vreqs.clear();
+        }
+        int vi = 0;
+        for (Pending& p : items)
+          p.run(p.need_verdict ? verdicts[vi++] != 0 : p.pre_ok);
+      }
+    }
+  }
+  e.in_flush = false;
+}
+
+// Python's VirtualNet increments its flush counter once per delivered
+// message / top-level input; flushing resets it.
+inline void engine_count_unit(Engine& e) {
+  if (!e.ext || e.in_flush) return;
+  e.since_flush++;
+  if (e.flush_every > 0 && e.since_flush >= (uint64_t)e.flush_every)
+    engine_flush_ext(e);
 }
 
 void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
@@ -2150,13 +2452,22 @@ void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
   Ctx ctx(e, node);
   fn(ctx);
   ctx.commit_events();
-  engine_flush_pool(e, node);
+  if (!e.ext) engine_flush_pool(e, node);
   e.depth--;
 }
 
 uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
   uint64_t processed = 0;
-  while (!e.queue.empty() && processed < max_deliveries) {
+  while (processed < max_deliveries) {
+    if (e.queue.empty()) {
+      // Idle: drain deferred verifications so progress can resume
+      // (VirtualNet.crank's empty-queue flush).
+      if (e.ext && e.pool_items > 0 && !e.in_flush) {
+        engine_flush_ext(e);
+        if (!e.queue.empty()) continue;
+      }
+      break;
+    }
     QItem item = std::move(e.queue.front());
     e.queue.pop_front();
     ++processed;
@@ -2165,6 +2476,7 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     e.delivered++;
     node.handled++;
     engine_unit(e, node, [&](Ctx& ctx) { ctx.deliver(item.sender, item.msg); });
+    engine_count_unit(e);
   }
   return processed;
 }
@@ -2284,6 +2596,7 @@ int32_t hbe_propose(void* h, int32_t node, int32_t era, const uint8_t* payload,
     ctx.commit_events();
   } else {
     engine_unit(*e, nd, [&](Ctx& ctx) { ctx.hb_propose(data); });
+    engine_count_unit(*e);  // VirtualNet.send_input's _maybe_flush
   }
   return 1;
 }
@@ -2315,6 +2628,90 @@ uint64_t hbe_batch_payload_len(void* h, int32_t i) {
 void hbe_batch_payload(void* h, int32_t i, uint8_t* out) {
   const Bytes& b = ((Engine*)h)->cur_batch[i].second;
   std::memcpy(out, b.data(), b.size());
+}
+
+// -- external-crypto mode --------------------------------------------------
+
+// Enable external (opaque-bytes) crypto: all share signing, combining,
+// ciphertext parsing, and verification happen Python-side through the
+// callbacks; flush_every mirrors VirtualNet's knob (0 = flush only when
+// the delivery queue runs dry — maximal batch amortization; identical
+// protocol outputs by the deferred-verification invariant).
+void hbe_set_ext_crypto(void* h, int32_t flush_every, VerifyBatchCb verify_cb,
+                        SignCb sign_cb, CombineCb combine_cb,
+                        CtParseCb ct_parse_cb) {
+  Engine* e = (Engine*)h;
+  e->ext = true;
+  e->flush_every = flush_every;
+  e->verify_cb = verify_cb;
+  e->sign_cb = sign_cb;
+  e->combine_cb = combine_cb;
+  e->ct_parse_cb = ct_parse_cb;
+}
+
+void hbe_set_flush_every(void* h, int32_t flush_every) {
+  ((Engine*)h)->flush_every = flush_every;
+}
+
+uint64_t hbe_pending_verifies(void* h) { return ((Engine*)h)->pool_items; }
+
+// Force a flush of all pending pools (top-level only).
+void hbe_flush(void* h) {
+  Engine* e = (Engine*)h;
+  if (e->ext && e->pool_items > 0) engine_flush_ext(*e);
+}
+
+// Bytes-return helper for Sign/Combine callbacks: Python calls this with
+// the opaque `ret` handle it was given.
+void hbe_ret_bytes(void* ret, const uint8_t* data, uint64_t len) {
+  ((Bytes*)ret)->assign((const char*)data, len);
+}
+
+// Verify-request accessors (valid during a VerifyBatchCb call).
+int32_t hbe_vreq_kind(void* h, int32_t i) {
+  return ((Engine*)h)->cur_vreqs[i]->kind;
+}
+int32_t hbe_vreq_era(void* h, int32_t i) {
+  return ((Engine*)h)->cur_vreqs[i]->era;
+}
+int32_t hbe_vreq_sender(void* h, int32_t i) {
+  return ((Engine*)h)->cur_vreqs[i]->sender;
+}
+uint64_t hbe_vreq_doc_len(void* h, int32_t i) {
+  const Bytes* d = ((Engine*)h)->cur_vreqs[i]->doc;
+  return d ? d->size() : 0;
+}
+void hbe_vreq_doc(void* h, int32_t i, uint8_t* out) {
+  const Bytes* d = ((Engine*)h)->cur_vreqs[i]->doc;
+  if (d) std::memcpy(out, d->data(), d->size());
+}
+uint64_t hbe_vreq_ct_len(void* h, int32_t i) {
+  const Bytes* d = ((Engine*)h)->cur_vreqs[i]->ct;
+  return d ? d->size() : 0;
+}
+void hbe_vreq_ct(void* h, int32_t i, uint8_t* out) {
+  const Bytes* d = ((Engine*)h)->cur_vreqs[i]->ct;
+  if (d) std::memcpy(out, d->data(), d->size());
+}
+uint64_t hbe_vreq_share_len(void* h, int32_t i) {
+  const auto& s = ((Engine*)h)->cur_vreqs[i]->share;
+  return s ? s->size() : 0;
+}
+void hbe_vreq_share(void* h, int32_t i, uint8_t* out) {
+  const auto& s = ((Engine*)h)->cur_vreqs[i]->share;
+  if (s) std::memcpy(out, s->data(), s->size());
+}
+
+// Combine-share accessors (valid during a CombineCb call).
+int32_t hbe_comb_index(void* h, int32_t i) {
+  return ((Engine*)h)->cur_comb[i].first;
+}
+uint64_t hbe_comb_share_len(void* h, int32_t i) {
+  return ((Engine*)h)->cur_comb[i].second->size();
+}
+void hbe_comb_share(void* h, int32_t i, uint8_t* out) {
+  const Bytes* b = ((Engine*)h)->cur_comb[i].second;
+  std::memcpy(out, b->data(), b->size());
 }
 
 // Fault log accessors (per observing node).

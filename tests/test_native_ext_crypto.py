@@ -1,0 +1,236 @@
+"""External-crypto engine mode: the native message loop fused with the
+real crypto plane (VERDICT round-2 item #1).
+
+Three fidelity pins:
+
+1. **Scalar-external == scalar-native == Python** — the whole callback
+   machinery (sign / verify-flush / combine / ct-parse) produces
+   byte-identical batches and fault logs to both the engine's internal
+   scalar path and the Python VirtualNet (cheap; runs on every suite
+   pass).
+2. **Flush-schedule invariance** — ``flush_every=0`` (flush only when
+   the delivery queue runs dry: maximal batch amortization) commits the
+   same outputs as eager verification, per the design invariant that
+   deferred verification is an optimization, never a semantics change.
+3. **BLS-external == BLS-Python** — a real BLS12-381 epoch under the
+   native loop matches the pure-Python VirtualNet at the same seed
+   (reference: real ``threshold_crypto`` under the native stack
+   throughout, SURVEY.md §2 #14).
+"""
+
+import pytest
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.available(), reason="native engine unavailable"
+)
+
+BATCH_SIZE = 8
+SESSION = b"qhb-test"
+
+
+def batch_key(b):
+    return (b.era, b.epoch, b.contributions, b.change, b.join_plan)
+
+
+def py_batches(net, nid):
+    return [o for o in net.node(nid).outputs if isinstance(o, DhbBatch)]
+
+
+def run_native(n, seed, f, inputs, want, chunk=1, **kw):
+    nat = native_engine.NativeQhbNet(
+        n, seed=seed, batch_size=BATCH_SIZE, num_faulty=f, session_id=SESSION,
+        **kw,
+    )
+    for nid, value in inputs:
+        nat.send_input(nid, value)
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= want for i in e.correct_ids),
+        chunk=chunk,
+    )
+    out = {
+        i: [batch_key(b) for b in nat.nodes[i].outputs] for i in nat.correct_ids
+    }
+    faults = {i: nat.faults(i) for i in range(n)}
+    nat.close()
+    return out, faults
+
+
+STEPS_N4 = [(nid, Input.user(f"tx-{nid}-{k}")) for k in range(3) for nid in range(4)]
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_ext_scalar_matches_native_scalar(seed):
+    base = run_native(4, seed, 0, STEPS_N4, 3)
+    ext = run_native(
+        4, seed, 0, STEPS_N4, 3, suite=ScalarSuite(), external_crypto=True,
+        flush_every=1,
+    )
+    assert base == ext
+
+
+@pytest.mark.parametrize("flush_every", [0, 7])
+def test_ext_scalar_flush_schedule_invariance(flush_every):
+    eager = run_native(
+        4, 3, 0, STEPS_N4, 3, suite=ScalarSuite(), external_crypto=True,
+        flush_every=1,
+    )
+    deferred = run_native(
+        4, 3, 0, STEPS_N4, 3, suite=ScalarSuite(), external_crypto=True,
+        flush_every=flush_every, chunk=10_000,
+    )
+    # Large chunks overshoot the stop predicate (more epochs commit
+    # before it is re-checked), so compare the common prefix: the first
+    # `want` batches per node must be identical.
+    for i, seq in eager[0].items():
+        assert deferred[0][i][: len(seq)] == seq
+    assert eager[1] == {i: f[: len(eager[1][i])] for i, f in deferred[1].items()}
+
+
+def test_ext_scalar_with_silent_faulty():
+    inputs = [(nid, Input.user(f"t{nid}.{k}")) for k in range(2) for nid in range(5)]
+    base = run_native(7, 5, 2, inputs, 2)
+    ext = run_native(
+        7, 5, 2, inputs, 2, suite=ScalarSuite(), external_crypto=True,
+        flush_every=1,
+    )
+    assert base == ext
+
+
+def test_ext_scalar_era_change():
+    """The external path through a full era change (votes, embedded DKG,
+    era restart): must match the engine's internal scalar path."""
+
+    def drive(**kw):
+        nat = native_engine.NativeQhbNet(
+            4, seed=11, batch_size=BATCH_SIZE, num_faulty=0, session_id=SESSION,
+            **kw,
+        )
+        keep = dict(nat.nodes[0].qhb.dhb._netinfo.public_key_map)
+        keep.pop(3)
+        change = Change.node_change(keep)
+        for nid in range(4):
+            nat.send_input(nid, Input.change(change))
+
+        def done(e):
+            return all(
+                any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+                for i in e.correct_ids
+            )
+
+        for r in range(8):
+            if done(nat):
+                break
+            for nid in range(4):
+                nat.send_input(nid, Input.user(f"e{r}-{nid}"))
+            want = r + 1
+            nat.run_until(
+                lambda e, w=want: all(
+                    len(e.nodes[i].outputs) >= w for i in e.correct_ids
+                ),
+                chunk=1,
+            )
+        assert done(nat)
+        era = nat.nodes[0].qhb.dhb.era
+        out = {
+            i: [batch_key(b) for b in nat.nodes[i].outputs]
+            for i in nat.correct_ids
+        }
+        faults = {i: nat.faults(i) for i in range(4)}
+        nat.close()
+        return out, faults, era
+
+    base = drive()
+    ext = drive(suite=ScalarSuite(), external_crypto=True, flush_every=1)
+    assert base == ext
+    assert base[2] >= 1  # the era actually advanced
+
+
+# ---------------------------------------------------------------------------
+# Real BLS12-381 under the native loop
+# ---------------------------------------------------------------------------
+
+
+def _bls_inputs():
+    return [(nid, Input.user(f"tx-{nid}-{k}")) for k in range(2) for nid in range(3)]
+
+
+def test_bls_native_matches_python_net():
+    """One real-BLS epoch: native engine vs Python VirtualNet, same seed,
+    byte-identical batches + fault logs (and the same delivery count —
+    the engine reproduces the Python net's schedule exactly)."""
+    from hbbft_tpu.crypto.bls import BLSSuite
+
+    pynet = (
+        NetBuilder(4, seed=1)
+        .num_faulty(1)
+        .max_cranks(10_000_000)
+        .suite(BLSSuite())
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni, sink, batch_size=BATCH_SIZE, session_id=SESSION
+            )
+        )
+        .build()
+    )
+    nat = native_engine.NativeQhbNet(
+        4, seed=1, batch_size=BATCH_SIZE, num_faulty=1, session_id=SESSION,
+        suite=BLSSuite(), flush_every=1,
+    )
+    for nid, value in _bls_inputs():
+        pynet.send_input(nid, value)
+        nat.send_input(nid, value)
+    pynet.crank_until(
+        lambda net: all(len(py_batches(net, i)) >= 1 for i in net.correct_ids),
+        max_cranks=10_000_000,
+    )
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+        chunk=1,
+    )
+    assert nat.delivered == pynet.delivered
+    for nid in pynet.correct_ids:
+        assert [batch_key(b) for b in py_batches(pynet, nid)] == [
+            batch_key(b) for b in nat.nodes[nid].outputs
+        ]
+        assert [(f.node_id, f.kind) for f in pynet.node(nid).faults] == nat.faults(
+            nid
+        )
+    nat.close()
+
+
+def test_bls_native_deferred_flush_amortizes():
+    """flush_every=0: same committed epoch, but verify requests actually
+    batch (>1 request per backend flush) — the deferred-verify design's
+    core claim, demonstrated end-to-end with real BLS."""
+    from hbbft_tpu.crypto.bls import BLSSuite
+
+    eager = run_native(
+        4, 1, 1, _bls_inputs(), 1, suite=BLSSuite(), flush_every=1
+    )
+    nat = native_engine.NativeQhbNet(
+        4, seed=1, batch_size=BATCH_SIZE, num_faulty=1, session_id=SESSION,
+        suite=BLSSuite(), flush_every=0,
+    )
+    for nid, value in _bls_inputs():
+        nat.send_input(nid, value)
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+        chunk=200,
+    )
+    deferred = (
+        {i: [batch_key(b) for b in nat.nodes[i].outputs] for i in nat.correct_ids},
+        {i: nat.faults(i) for i in range(4)},
+    )
+    stats = dict(nat.flush_stats)
+    nat.close()
+    assert eager == deferred
+    assert stats["max_batch"] > 1, stats
+    # Cross-node dedup: identical requests observed by several nodes hit
+    # the backend once.
+    assert stats["backend_requests"] < stats["requests"], stats
