@@ -104,6 +104,7 @@ _COMBINE_CB = ctypes.CFUNCTYPE(
 _CT_PARSE_CB = ctypes.CFUNCTYPE(
     ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
 )
+_PRE_CRANK_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -160,6 +161,12 @@ def _load() -> Optional[ctypes.CDLL]:
         _CT_PARSE_CB,
     ]
     lib.hbe_set_flush_every.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_set_pre_crank.argtypes = [ctypes.c_void_p, _PRE_CRANK_CB]
+    lib.hbe_queue_swap.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.hbe_queue_dest.restype = ctypes.c_int32
+    lib.hbe_queue_dest.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.hbe_pending_verifies.restype = ctypes.c_uint64
     lib.hbe_pending_verifies.argtypes = [ctypes.c_void_p]
     lib.hbe_flush.argtypes = [ctypes.c_void_p]
@@ -386,6 +393,7 @@ class NativeQhbNet:
         backend: Optional[CryptoBackend] = None,
         flush_every: int = 1,
         external_crypto: Optional[bool] = None,
+        adversary: Any = None,
     ) -> None:
         lib = get_lib()
         if lib is None:
@@ -415,6 +423,8 @@ class NativeQhbNet:
         faulty = val_ids[n - f :] if f else []
         self.faulty_ids = list(faulty)
         self.correct_ids = [i for i in range(n) if i not in faulty]
+        # VirtualNet.node_order (Target.all expansion + NodeOrderAdversary)
+        self.node_order = sorted(self.correct_ids) + sorted(self.faulty_ids)
 
         self.handle = lib.hbe_create(n, f)
         assert self.handle
@@ -425,6 +435,42 @@ class NativeQhbNet:
 
         self.backend: Optional[CryptoBackend] = None
         self._cb_error: Optional[BaseException] = None
+        # The net-level rng continues past key generation exactly like
+        # NetBuilder's, so a seeded adversary replayed against the
+        # engine queue consumes the SAME stream as the VirtualNet's.
+        self._net_rng = rng
+        self._adversary = adversary
+        if adversary is not None:
+            from hbbft_tpu.net.adversary import (
+                NodeOrderAdversary,
+                NullAdversary,
+                RandomAdversary,
+                ReorderingAdversary,
+            )
+
+            # EXACT stock types only: the replay reproduces these
+            # implementations' rng consumption precisely; a subclass
+            # with an overridden pre_crank would silently diverge.
+            if type(adversary) is not NullAdversary:
+                if type(adversary) not in (
+                    ReorderingAdversary, RandomAdversary, NodeOrderAdversary
+                ):
+                    raise ValueError(
+                        "engine supports the stock scheduling adversaries "
+                        "only (Reordering/Random/NodeOrder); tampering and "
+                        "subclasses run on the Python VirtualNet"
+                    )
+                if (
+                    type(adversary) is RandomAdversary
+                    and adversary.replay_p > 0
+                ):
+                    raise ValueError(
+                        "RandomAdversary replay (replay_p > 0) consumes rng "
+                        "on faulty-destined deliveries and injects messages; "
+                        "run it on the Python VirtualNet"
+                    )
+                self._pre_crank_cb = _PRE_CRANK_CB(self._on_pre_crank)
+                lib.hbe_set_pre_crank(self.handle, self._pre_crank_cb)
         if self.ext:
             self.backend = backend if backend is not None else BatchedBackend(suite)
             self._node_era_info: Dict[Tuple[int, int], NetworkInfo] = {}
@@ -686,6 +732,54 @@ class NativeQhbNet:
         except BaseException as exc:  # pragma: no cover - defensive
             if self._cb_error is None:
                 self._cb_error = exc
+
+    def _on_pre_crank(self, qlen: int) -> None:
+        """Replay the seeded scheduling adversary against the engine
+        queue — the exact per-crank rng consumption of the Python
+        Adversary.pre_crank hooks, so schedules match at the same seed."""
+        try:
+            adv = self._adversary
+            rng = self._net_rng
+            lib, h = self.lib, self.handle
+            from hbbft_tpu.net.adversary import (
+                NodeOrderAdversary,
+                RandomAdversary,
+                ReorderingAdversary,
+            )
+
+            if isinstance(adv, ReorderingAdversary):
+                for _ in range(min(adv.swaps_per_crank, qlen)):
+                    i = rng.randrange(qlen)
+                    j = rng.randrange(qlen)
+                    lib.hbe_queue_swap(h, i, j)
+            elif isinstance(adv, RandomAdversary):
+                if qlen > 1:
+                    i = rng.randrange(qlen)
+                    lib.hbe_queue_swap(h, 0, i)
+            elif isinstance(adv, NodeOrderAdversary):
+                if qlen:
+                    order = {nid: k for k, nid in enumerate(self.node_order)}
+                    dests = [lib.hbe_queue_dest(h, i) for i in range(qlen)]
+                    perm = sorted(range(qlen), key=lambda i: order[dests[i]])
+                    self._apply_queue_perm(perm)
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+
+    def _apply_queue_perm(self, perm: List[int]) -> None:
+        """Reorder the engine queue to `perm` (perm[new] = old) with
+        swaps (mirrors a stable in-place sort result)."""
+        lib, h = self.lib, self.handle
+        pos = list(range(len(perm)))  # old index -> current position
+        at = list(range(len(perm)))   # position -> old index
+        for new, old in enumerate(perm):
+            p = pos[old]
+            if p == new:
+                continue
+            lib.hbe_queue_swap(h, new, p)
+            displaced = at[new]
+            at[new], at[p] = old, displaced
+            pos[old], pos[displaced] = new, p
 
     def _on_ct_parse(self, node: int, ptr: Any, length: int) -> int:
         """serde decode gate for subset-accepted payloads — the exact

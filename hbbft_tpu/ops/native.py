@@ -77,6 +77,12 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.hb_rs_reconstruct.argtypes = [u8p, u64p, ctypes.c_uint64,
                                       ctypes.c_uint64, ctypes.c_uint64, u8p]
     lib.hb_rs_reconstruct.restype = ctypes.c_int
+    lib.hb_rs16_encode.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64,
+                                   ctypes.c_uint64, u8p]
+    lib.hb_rs16_encode.restype = ctypes.c_int
+    lib.hb_rs16_reconstruct.argtypes = [u8p, u64p, ctypes.c_uint64,
+                                        ctypes.c_uint64, ctypes.c_uint64, u8p]
+    lib.hb_rs16_reconstruct.restype = ctypes.c_int
     return lib
 
 
@@ -166,6 +172,41 @@ def rs_reconstruct(shards: Dict[int, bytes], k: int, n: int) -> Optional[List[by
     idx_arr = np.asarray(idxs, dtype=np.uint64)
     out = np.zeros((k, size), dtype=np.uint8)
     rc = _get().hb_rs_reconstruct(
+        _u8(have),
+        idx_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        k, n, size, _u8(out),
+    )
+    if rc != 0:
+        return None
+    return [bytes(r) for r in out]
+
+
+def rs16_encode(data_shards: Sequence[bytes], n: int) -> Optional[List[bytes]]:
+    """GF(2^16) variant of :func:`rs_encode` (even shard lengths)."""
+    k = len(data_shards)
+    size = len(data_shards[0])
+    data = np.frombuffer(b"".join(data_shards), dtype=np.uint8).reshape(k, size)
+    data = np.ascontiguousarray(data)
+    parity = np.zeros((n - k, size), dtype=np.uint8)
+    rc = _get().hb_rs16_encode(_u8(data), k, n, size, _u8(parity))
+    if rc != 0:
+        return None
+    return [bytes(s) for s in data] + [bytes(p) for p in parity]
+
+
+def rs16_reconstruct(
+    shards: Dict[int, bytes], k: int, n: int
+) -> Optional[List[bytes]]:
+    """GF(2^16) variant of :func:`rs_reconstruct`."""
+    idxs = sorted(shards)[:k]
+    size = len(shards[idxs[0]])
+    have = np.frombuffer(
+        b"".join(shards[i] for i in idxs), dtype=np.uint8
+    ).reshape(k, size)
+    have = np.ascontiguousarray(have)
+    idx_arr = np.asarray(idxs, dtype=np.uint64)
+    out = np.zeros((k, size), dtype=np.uint8)
+    rc = _get().hb_rs16_reconstruct(
         _u8(have),
         idx_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         k, n, size, _u8(out),

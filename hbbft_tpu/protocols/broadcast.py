@@ -33,7 +33,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
-from hbbft_tpu.ops.gf256 import ReedSolomon
+from hbbft_tpu.ops.gf256 import rs_codec
 from hbbft_tpu.ops.merkle import MerkleTree, Proof
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step, Target
@@ -76,10 +76,15 @@ class CanDecodeMsg:
     root: bytes
 
 
-def _pack(value: bytes, k: int) -> Tuple[bytes, ...]:
-    """Length-prefix and pad ``value`` into k equal shards."""
+def _pack(value: bytes, k: int, align: int = 1) -> Tuple[bytes, ...]:
+    """Length-prefix and pad ``value`` into k equal shards.
+
+    ``align=2`` for the GF(2^16) codec (validator sets > 255): its
+    symbols are 2 bytes, so shard lengths must be even.
+    """
     payload = len(value).to_bytes(8, "big") + value
     shard_len = max(1, -(-len(payload) // k))
+    shard_len = -(-shard_len // align) * align
     payload = payload.ljust(k * shard_len, b"\x00")
     return tuple(payload[i * shard_len : (i + 1) * shard_len] for i in range(k))
 
@@ -102,7 +107,9 @@ class Broadcast(ConsensusProtocol):
         self._proposer = proposer_id
         n, f = netinfo.num_nodes, netinfo.num_faulty
         self._data_shards = n - 2 * f
-        self._rs = ReedSolomon(self._data_shards, n)
+        # GF(256) up to 255 validators (reference-matching byte layout);
+        # GF(2^16) beyond — GF(256) has no 256th Vandermonde point.
+        self._rs = rs_codec(self._data_shards, n)
         self._echos: Dict[Any, Proof] = {}
         self._echo_hashes: Dict[Any, bytes] = {}
         self._readys: Dict[Any, bytes] = {}
@@ -130,7 +137,9 @@ class Broadcast(ConsensusProtocol):
     def handle_input(self, input: bytes, rng: Any) -> Step:
         if self.our_id != self._proposer or self._had_input:
             return Step.empty()
-        shards = self._rs.encode(list(_pack(bytes(input), self._data_shards)))
+        shards = self._rs.encode(
+            list(_pack(bytes(input), self._data_shards, self._rs.shard_align))
+        )
         tree = MerkleTree(shards)
         return self.propose_with_proofs([tree.proof(i) for i in range(self._netinfo.num_nodes)])
 
@@ -362,7 +371,9 @@ def batch_propose(broadcasts, values):
     for idx, (bc, value) in enumerate(zip(broadcasts, values)):
         k, n = bc._data_shards, bc._netinfo.num_nodes
         _, shard_len = _dataplane()._pack(bytes(value), k)
-        if shard_len <= _dataplane().MAX_DEV_SHARD:
+        # The device dataplane is GF(256)-only; > 255 validators use the
+        # GF(2^16) host codec via the ordinary propose path.
+        if n <= 255 and shard_len <= _dataplane().MAX_DEV_SHARD:
             groups[(k, n, shard_len)].append(idx)
         else:
             steps[idx] = bc.handle_input(bytes(value), None)

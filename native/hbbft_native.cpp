@@ -161,4 +161,65 @@ int hb_rs_reconstruct(const uint8_t* shards, const uint64_t* idxs, uint64_t k,
   return 0;
 }
 
+// -- GF(2^16) variants (validator sets > 255; symbols 2B big-endian) -------
+
+namespace {
+
+// Shared per-(k, n) GF(2^16) matrix cache (same rationale as the
+// GF(256) encoding_matrix helper above).
+bool encoding_matrix16(uint64_t k, uint64_t n,
+                       const std::vector<uint16_t>*& out) {
+  static std::mutex mu;
+  static std::map<std::pair<uint64_t, uint64_t>, std::vector<uint16_t>> cache;
+  std::lock_guard<std::mutex> lock(mu);
+  auto key = std::make_pair(k, n);
+  auto it = cache.find(key);
+  if (it == cache.end()) {
+    std::vector<uint16_t> m;
+    if (!hbn::encoding_matrix16_t<std::vector<uint16_t>>(k, n, m))
+      return false;
+    it = cache.emplace(key, std::move(m)).first;
+  }
+  out = &it->second;
+  return true;
+}
+
+}  // namespace
+
+// data: k x size bytes (size even) -> parity: (n-k) x size bytes.
+int hb_rs16_encode(const uint8_t* data, uint64_t k, uint64_t n, uint64_t size,
+                   uint8_t* parity) {
+  if (!k || k > n || n > 65535 || size % 2) return 1;
+  const std::vector<uint16_t>* mat;
+  if (!encoding_matrix16(k, n, mat)) return 2;
+  uint64_t nsym = size / 2;
+  std::vector<uint16_t> dsym(k * nsym), psym((n - k) * nsym);
+  hbn::bytes_to_sym16(data, k * nsym, dsym.data());
+  hbn::gf16_matmul(mat->data() + k * k, dsym.data(), psym.data(), n - k, k,
+                   nsym);
+  hbn::sym16_to_bytes(psym.data(), (n - k) * nsym, parity);
+  return 0;
+}
+
+int hb_rs16_reconstruct(const uint8_t* shards, const uint64_t* idxs,
+                        uint64_t k, uint64_t n, uint64_t size, uint8_t* out) {
+  if (!k || k > n || n > 65535 || size % 2) return 1;
+  const std::vector<uint16_t>* mat;
+  if (!encoding_matrix16(k, n, mat)) return 2;
+  std::vector<uint16_t> sub(k * k);
+  for (uint64_t r = 0; r < k; ++r) {
+    if (idxs[r] >= n) return 3;
+    std::memcpy(sub.data() + r * k, mat->data() + idxs[r] * k, 2 * k);
+  }
+  std::vector<uint16_t> dec(k * k);
+  if (!hbn::gf16_mat_inv_t<std::vector<uint16_t>>(sub.data(), dec.data(), k))
+    return 4;
+  uint64_t nsym = size / 2;
+  std::vector<uint16_t> hsym(k * nsym), dsym(k * nsym);
+  hbn::bytes_to_sym16(shards, k * nsym, hsym.data());
+  hbn::gf16_matmul(dec.data(), hsym.data(), dsym.data(), k, k, nsym);
+  hbn::sym16_to_bytes(dsym.data(), k * nsym, out);
+  return 0;
+}
+
 }  // extern "C"

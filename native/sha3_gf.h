@@ -207,8 +207,11 @@ inline bool gf_mat_inv_t(const uint8_t* m_in, uint8_t* inv_out, size_t n) {
 }
 
 // Systematic n x k encoding matrix (gf256.encoding_matrix semantics).
+// GF(256) Vandermonde points exp[i] are distinct only for n <= 255 —
+// callers MUST use the GF(2^16) codec below past that.
 template <typename Vec>
 inline bool encoding_matrix_t(size_t k, size_t n, Vec& out) {
+  if (n > 255) return false;
   Vec vand(n * k);
   for (size_t i = 0; i < n; ++i)
     for (size_t j = 0; j < k; ++j) vand[i * k + j] = gf().exp[(i * j) % 255];
@@ -217,6 +220,126 @@ inline bool encoding_matrix_t(size_t k, size_t n, Vec& out) {
   out.assign(n * k, 0);
   gf_matmul(vand.data(), top_inv.data(), out.data(), n, k, k);
   return true;
+}
+
+// -- GF(2^16), poly 0x1100B, generator 2 ------------------------------------
+//
+// The large-validator-set RBC codec: GF(256) runs out of distinct
+// Vandermonde evaluation points at 255 shards, so networks with more
+// than 255 validators erasure-code over GF(2^16) (65535 points).
+// Symbols are TWO bytes, big-endian on the wire (matches the numpy
+// '>u2' view in ops/gf256.py); shard lengths must be even.
+
+struct Gf16Tables {
+  std::vector<uint16_t> exp;  // 2*65535 (wraparound, no mod in mul)
+  std::vector<int32_t> log;   // 65536
+  Gf16Tables() : exp(131070, 0), log(65536, 0) {
+    uint32_t x = 1;
+    for (int i = 0; i < 65535; ++i) {
+      exp[i] = static_cast<uint16_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x10000) x ^= 0x1100B;
+    }
+    for (int i = 0; i < 65535; ++i) exp[65535 + i] = exp[i];
+  }
+};
+
+inline const Gf16Tables& gf16() {
+  static const Gf16Tables tables;
+  return tables;
+}
+
+inline uint16_t gf16_mul(uint16_t a, uint16_t b) {
+  if (!a || !b) return 0;
+  const Gf16Tables& t = gf16();
+  return t.exp[t.log[a] + t.log[b]];
+}
+
+inline uint16_t gf16_inv(uint16_t a) {
+  const Gf16Tables& t = gf16();
+  return t.exp[65535 - t.log[a]];
+}
+
+// out = a @ b over GF(2^16); dims m x k @ k x n, u16 symbol arrays.
+inline void gf16_matmul(const uint16_t* a, const uint16_t* b, uint16_t* out,
+                        size_t m, size_t k, size_t n) {
+  const Gf16Tables& t = gf16();
+  std::memset(out, 0, m * n * 2);
+  for (size_t r = 0; r < m; ++r) {
+    for (size_t i = 0; i < k; ++i) {
+      uint16_t coef = a[r * k + i];
+      if (!coef) continue;
+      int32_t lc = t.log[coef];
+      const uint16_t* row = b + i * n;
+      uint16_t* dst = out + r * n;
+      for (size_t c = 0; c < n; ++c)
+        if (row[c]) dst[c] ^= t.exp[lc + t.log[row[c]]];
+    }
+  }
+}
+
+template <typename Vec16>
+inline bool gf16_mat_inv_t(const uint16_t* m_in, uint16_t* inv_out, size_t n) {
+  Vec16 a(m_in, m_in + n * n);
+  Vec16 inv(n * n, 0);
+  for (size_t i = 0; i < n; ++i) inv[i * n + i] = 1;
+  for (size_t col = 0; col < n; ++col) {
+    size_t pivot = col;
+    while (pivot < n && !a[pivot * n + col]) ++pivot;
+    if (pivot == n) return false;
+    if (pivot != col) {
+      for (size_t j = 0; j < n; ++j) {
+        std::swap(a[col * n + j], a[pivot * n + j]);
+        std::swap(inv[col * n + j], inv[pivot * n + j]);
+      }
+    }
+    uint16_t pinv = gf16_inv(a[col * n + col]);
+    for (size_t j = 0; j < n; ++j) {
+      a[col * n + j] = gf16_mul(a[col * n + j], pinv);
+      inv[col * n + j] = gf16_mul(inv[col * n + j], pinv);
+    }
+    for (size_t r = 0; r < n; ++r) {
+      uint16_t f = a[r * n + col];
+      if (r == col || !f) continue;
+      for (size_t j = 0; j < n; ++j) {
+        a[r * n + j] ^= gf16_mul(a[col * n + j], f);
+        inv[r * n + j] ^= gf16_mul(inv[col * n + j], f);
+      }
+    }
+  }
+  std::memcpy(inv_out, inv.data(), n * n * 2);
+  return true;
+}
+
+// Systematic n x k encoding matrix over GF(2^16) (points exp16[i],
+// distinct for n <= 65535).
+template <typename Vec16>
+inline bool encoding_matrix16_t(size_t k, size_t n, Vec16& out) {
+  if (n > 65535) return false;
+  const Gf16Tables& t = gf16();
+  Vec16 vand(n * k);
+  for (size_t i = 0; i < n; ++i)
+    for (size_t j = 0; j < k; ++j)
+      vand[i * k + j] = t.exp[(i * j) % 65535];
+  Vec16 top_inv(k * k);
+  if (!gf16_mat_inv_t<Vec16>(vand.data(), top_inv.data(), k)) return false;
+  out.assign(n * k, 0);
+  gf16_matmul(vand.data(), top_inv.data(), out.data(), n, k, k);
+  return true;
+}
+
+// Big-endian byte <-> u16 symbol conversion (wire format).
+inline void bytes_to_sym16(const uint8_t* in, size_t n_sym, uint16_t* out) {
+  for (size_t i = 0; i < n_sym; ++i)
+    out[i] = (uint16_t)((in[2 * i] << 8) | in[2 * i + 1]);
+}
+
+inline void sym16_to_bytes(const uint16_t* in, size_t n_sym, uint8_t* out) {
+  for (size_t i = 0; i < n_sym; ++i) {
+    out[2 * i] = (uint8_t)(in[i] >> 8);
+    out[2 * i + 1] = (uint8_t)(in[i] & 0xFF);
+  }
 }
 
 }  // namespace hbn

@@ -1,0 +1,141 @@
+"""GF(2^16) Reed-Solomon: the large-validator-set RBC codec.
+
+GF(256) has only 255 distinct Vandermonde evaluation points, so the old
+engine cap at 256 nodes was load-bearing: past 255 shards, rows repeat
+and decode subsets turn singular.  Networks with > 255 validators now
+erasure-code over GF(2^16) (65535 points).  These tests pin:
+
+* field arithmetic + primitivity of poly 0x1100B / generator 2,
+* systematic encode/reconstruct roundtrips with adversarial erasure
+  patterns — including the index pairs (i, i+255) that are IDENTICAL
+  rows over GF(256),
+* bit-identity between the numpy codec and the native C++ codec
+  (native/sha3_gf.h), which the engine uses for N > 255,
+* the Broadcast codec switch (`rs_codec`) and even-shard packing.
+"""
+
+import random
+
+import pytest
+
+from hbbft_tpu.ops import gf256
+from hbbft_tpu.ops import native as native_ops
+from hbbft_tpu.protocols.broadcast import _pack, _unpack
+
+
+def test_gf16_field_basics():
+    exp, log = gf256._tables16()
+    # primitivity: generator cycles through all 65535 nonzero elements
+    assert len(set(int(x) for x in exp[:65535])) == 65535
+    rng = random.Random(0)
+    for _ in range(200):
+        a = rng.randrange(1, 65536)
+        b = rng.randrange(1, 65536)
+        ab = gf256.gf16_mul(a, b)
+        assert gf256.gf16_mul(ab, gf256.gf16_inv(b)) == a
+    assert gf256.gf16_mul(0, 12345) == 0
+    assert gf256.gf16_inv(1) == 1
+
+
+def test_gf16_matmul_matches_scalar():
+    import numpy as np
+
+    rng = random.Random(1)
+    a = np.array(
+        [[rng.randrange(65536) for _ in range(5)] for _ in range(4)],
+        dtype=np.uint16,
+    )
+    b = np.array(
+        [[rng.randrange(65536) for _ in range(3)] for _ in range(5)],
+        dtype=np.uint16,
+    )
+    out = gf256.gf16_matmul(a, b)
+    for i in range(4):
+        for j in range(3):
+            acc = 0
+            for t in range(5):
+                acc ^= gf256.gf16_mul(int(a[i, t]), int(b[t, j]))
+            assert int(out[i, j]) == acc
+
+
+def test_rs16_systematic_and_roundtrip_past_gf256_wall():
+    """n=300 > 255: reconstruct from subsets that include (i, i+255)
+    pairs — identical encoding rows over GF(256), distinct here."""
+    k, n = 86, 300
+    rng = random.Random(2)
+    size = 8
+    data = [bytes(rng.randrange(256) for _ in range(size)) for _ in range(k)]
+    rs = gf256.ReedSolomon16(k, n)
+    shards = rs.encode(data)
+    assert len(shards) == n
+    assert shards[:k] == data  # systematic
+    # worst-case subset for GF(256): indices 0..44 and 255..295 overlap
+    # mod 255 (rows 255+i == rows i over the smaller field)
+    subset = {i: shards[i] for i in range(45)}
+    subset.update({i: shards[i] for i in range(255, 296)})
+    assert len(subset) == 86
+    assert rs.reconstruct(subset) == data
+    # random erasure patterns
+    for _ in range(3):
+        idxs = rng.sample(range(n), k)
+        assert rs.reconstruct({i: shards[i] for i in idxs}) == data
+
+
+def test_rs16_native_matches_numpy():
+    if not native_ops.available():
+        pytest.skip("native data plane unavailable")
+    k, n = 12, 280
+    rng = random.Random(3)
+    size = 10
+    data = [bytes(rng.randrange(256) for _ in range(size)) for _ in range(k)]
+    rs = gf256.ReedSolomon16(k, n)
+    # numpy path explicitly (bypass the native fast path)
+    import numpy as np
+
+    sym = np.stack([rs._sym(s) for s in data])
+    parity_np = [rs._bytes(p) for p in gf256.gf16_matmul(rs.matrix[k:], sym)]
+    native_out = native_ops.rs16_encode(data, n)
+    assert native_out is not None
+    assert native_out[k:] == parity_np
+    idxs = rng.sample(range(n), k)
+    subset = {i: native_out[i] for i in idxs}
+    nat_rec = native_ops.rs16_reconstruct(subset, k, n)
+    sub = rs.matrix[sorted(idxs)[:k]]
+    dec = gf256.gf16_mat_inv(sub)
+    have = np.stack([rs._sym(subset[i]) for i in sorted(idxs)[:k]])
+    np_rec = [rs._bytes(r) for r in gf256.gf16_matmul(dec, have)]
+    assert nat_rec == np_rec == data
+
+
+def test_rs_codec_switch_and_pack_alignment():
+    assert isinstance(gf256.rs_codec(3, 10), gf256.ReedSolomon)
+    assert isinstance(gf256.rs_codec(86, 255), gf256.ReedSolomon)
+    assert isinstance(gf256.rs_codec(86, 256), gf256.ReedSolomon16)
+    # even-shard packing for the 2-byte-symbol codec, roundtrip intact
+    value = b"x" * 101
+    shards = _pack(value, 7, align=2)
+    assert all(len(s) % 2 == 0 for s in shards)
+    assert _unpack(shards) == value
+    assert _unpack(_pack(b"", 5, align=2)) == b""
+
+
+def test_gf256_reed_solomon_still_rejects_past_255():
+    with pytest.raises(AssertionError):
+        gf256.ReedSolomon(86, 256)
+
+
+def test_engine_rbc_decodes_past_255_nodes():
+    """The native engine at N=257 rides the GF(2^16) codec: broadcasts
+    from a proposer must decode (every decode re-encodes the full
+    codeword and re-verifies the Merkle root — a codec bug would fault
+    the honest proposer within the first RBC)."""
+    from hbbft_tpu import native_engine
+    from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+    if not native_engine.available():
+        pytest.skip("native engine unavailable")
+    nat = native_engine.NativeQhbNet(257, seed=0, batch_size=8, num_faulty=0)
+    nat.send_input(0, Input.user("big-n-tx"))
+    nat.run(2_000_000)
+    assert all(nat.faults(i) == [] for i in range(257))
+    nat.close()
