@@ -130,9 +130,23 @@ class Commitment:
         hit = cache.get(x)
         if hit is not None:
             return hit
-        acc = None
-        for e in reversed(self.elems):
-            acc = e if acc is None else acc * x + e
+        from hbbft_tpu.crypto.suite import ScalarG
+
+        first = self.elems[0] if self.elems else None
+        if type(first) is ScalarG:
+            # Scalar-suite fast path: Horner over raw ints, ONE group
+            # object out.  The generic loop allocates two ScalarG per
+            # coefficient, which dominated the N^3 DKG ack checks
+            # (protocol-plane benchmarks run this suite).
+            m = first.modulus
+            acc_i = 0
+            for e in reversed(self.elems):
+                acc_i = (acc_i * x + e.value) % m
+            acc = type(first)(acc_i, m)
+        else:
+            acc = None
+            for e in reversed(self.elems):
+                acc = e if acc is None else acc * x + e
         cache[x] = acc
         return acc
 

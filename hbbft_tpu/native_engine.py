@@ -67,7 +67,17 @@ from hbbft_tpu.utils import canonical_bytes, serde
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "native", "engine.cpp")
-_SO = os.path.join(_ROOT, "native", "build", "libhbbft_engine.so")
+# One shared library per NodeSet width (-DHBE_WORDS): the 4-word build
+# serves the common <= 256-node range at full speed; wider builds are
+# compiled on demand for larger networks (see engine.cpp's NodeSet).
+_SO_TMPL = os.path.join(_ROOT, "native", "build", "libhbbft_engine_w{w}.so")
+
+
+def _words_for(n: int) -> int:
+    w = 4
+    while 64 * w < n:
+        w *= 2
+    return w
 
 _BATCH_CB = ctypes.CFUNCTYPE(None, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32)
 _CONTRIB_CB = ctypes.CFUNCTYPE(
@@ -107,10 +117,13 @@ _CT_PARSE_CB = ctypes.CFUNCTYPE(
 _PRE_CRANK_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
 
 
-def _load() -> Optional[ctypes.CDLL]:
+def _load(words: int) -> Optional[ctypes.CDLL]:
     from hbbft_tpu.ops.native import build_and_load
 
-    lib = build_and_load(_SRC, _SO)
+    lib = build_and_load(
+        _SRC, _SO_TMPL.format(w=words),
+        extra_flags=(f"-DHBE_WORDS={words}",),
+    )
     if lib is None:
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -188,16 +201,13 @@ def _load() -> Optional[ctypes.CDLL]:
     return lib
 
 
-_LIB: Optional[ctypes.CDLL] = None
-_LOADED = False
+_LIBS: Dict[int, Optional[ctypes.CDLL]] = {}
 
 
-def get_lib() -> Optional[ctypes.CDLL]:
-    global _LIB, _LOADED
-    if not _LOADED:
-        _LIB = _load()
-        _LOADED = True
-    return _LIB
+def get_lib(words: int = 4) -> Optional[ctypes.CDLL]:
+    if words not in _LIBS:
+        _LIBS[words] = _load(words)
+    return _LIBS[words]
 
 
 def available() -> bool:
@@ -395,7 +405,7 @@ class NativeQhbNet:
         external_crypto: Optional[bool] = None,
         adversary: Any = None,
     ) -> None:
-        lib = get_lib()
+        lib = get_lib(_words_for(n))
         if lib is None:
             raise RuntimeError("native engine unavailable (no compiler?)")
         self.lib = lib
@@ -502,6 +512,7 @@ class NativeQhbNet:
         # contributions are treated as immutable by every consumer (QHB
         # absorb, DHB batch processing), so sharing is safe.
         self._decode_cache: Dict[bytes, Any] = {}
+        self._slot_cache: Dict[tuple, Any] = {}  # (era, epoch, proposer, len)
         for i in range(n):
             netinfo = NetworkInfo(
                 our_id=i,
@@ -528,20 +539,35 @@ class NativeQhbNet:
 
     # -- engine callbacks ----------------------------------------------
     def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
+        # Committed payloads for a (era, epoch, proposer) slot are
+        # byte-identical across every node (Subset agreement — the
+        # engine's equivalence tests pin this), so after the first node
+        # decodes a slot, later nodes skip both the payload copy and
+        # the content-keyed lookup (DKG payloads are hundreds of KB).
+        slot = (era, epoch, proposer, length)
+        hit = self._slot_cache.get(slot)
+        if hit is not None:
+            if hit is _DECODE_FAILED:
+                return 0
+            self.nodes[node].contrib_cache[(era, epoch, proposer)] = hit
+            return 1
         # ctypes.string_at = one memcpy; pointer slicing (data[:length])
         # is per-element and cost ~12 ms on DKG-sized (~100 KB) payloads.
         payload = ctypes.string_at(data, length) if length else b""
         if payload in self._decode_cache:
             obj = self._decode_cache[payload]
             if obj is _DECODE_FAILED:
+                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
                 return 0
         else:
             try:
                 obj = serde.loads(payload, suite=self._suite)
             except serde.DecodeError:
                 _cache_put(self._decode_cache, payload, _DECODE_FAILED)
+                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
                 return 0
             _cache_put(self._decode_cache, payload, obj)
+        _cache_put(self._slot_cache, slot, obj)
         self.nodes[node].contrib_cache[(era, epoch, proposer)] = obj
         return 1
 

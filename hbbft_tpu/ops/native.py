@@ -26,7 +26,10 @@ _SRC = os.path.join(_ROOT, "native", "hbbft_native.cpp")
 _SO = os.path.join(_ROOT, "native", "build", "libhbbft_native.so")
 
 
-def build_and_load(src: str, so: str, timeout: int = 300) -> Optional[ctypes.CDLL]:
+def build_and_load(
+    src: str, so: str, timeout: int = 300,
+    extra_flags: Sequence[str] = (),
+) -> Optional[ctypes.CDLL]:
     """Compile ``src`` into ``so`` if stale and dlopen it; None on any
     failure (callers fall back to pure-Python paths).
 
@@ -35,6 +38,10 @@ def build_and_load(src: str, so: str, timeout: int = 300) -> Optional[ctypes.CDL
     build lands in a process-unique temp path then atomically renames:
     other processes may have the current .so mapped, and a concurrent
     importer must never CDLL a half-written file.
+
+    ``extra_flags``: additional g++ flags (e.g. the engine's
+    ``-DHBE_WORDS=N`` NodeSet-width parameter); callers must encode
+    flag-relevant state in the ``so`` filename.
     """
     if os.environ.get("HBBFT_TPU_NO_NATIVE"):
         return None
@@ -48,7 +55,8 @@ def build_and_load(src: str, so: str, timeout: int = 300) -> Optional[ctypes.CDL
             os.makedirs(os.path.dirname(so), exist_ok=True)
             tmp = f"{so}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src],
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                 *extra_flags, "-o", tmp, src],
                 check=True,
                 capture_output=True,
                 timeout=timeout,
