@@ -81,11 +81,15 @@ def main() -> None:
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "480"))
     tpu_ok, note = probe_tpu()
     if not tpu_ok:
-        # CPU fallback: same kernel, small batch (a cold CPU compile or a
-        # big-batch CPU run of the 255-bit scans would blow any driver
-        # timeout; 64 shares keeps the whole fallback under ~5 min solo).
+        # CPU fallback: same kernel, small batches.  Sweep several sizes
+        # so even a fallback round carries scaling signal (round-2
+        # VERDICT weak #4); the deadline check between sizes keeps a
+        # slow box from blowing the driver timeout.
         os.environ["JAX_PLATFORMS"] = "cpu"
-        sizes = [int(os.environ.get("BENCH_SHARES_FALLBACK", "64"))]
+        if os.environ.get("BENCH_SHARES_FALLBACK"):
+            sizes = [int(os.environ["BENCH_SHARES_FALLBACK"])]
+        else:
+            sizes = [16, 64, 256]
     else:
         # Escalate through bucket sizes toward the north-star batch
         # (VERDICT round 1 asked for 2048 and 10240); report the largest
@@ -137,13 +141,21 @@ def main() -> None:
         return n_shares / dt
 
     best_rate, best_n, all_rates = 0.0, 0, {}
-    for n_shares in sizes:
+    for i, n_shares in enumerate(sizes):
         rate = measure(n_shares)
         all_rates[str(n_shares)] = round(rate, 2)
         if rate > best_rate:
             best_rate, best_n = rate, n_shares
-        if time.monotonic() - start > deadline_s:
+        elapsed = time.monotonic() - start
+        if elapsed > deadline_s:
             break
+        # A larger batch costs roughly proportionally more; skip the
+        # next escalation if it clearly cannot fit the deadline.
+        if i + 1 < len(sizes) and rate > 0:
+            projected = sizes[i + 1] / rate * 2  # warm + timed run
+            if elapsed + projected > deadline_s:
+                all_rates[f"skipped_{sizes[i + 1]}"] = "deadline"
+                break
 
     rate = best_rate
     payload = {
@@ -188,10 +200,26 @@ def _keccak_pallas_stats() -> dict:
     t0 = time.perf_counter()
     kp.sha3_256_batch(msgs)
     dt = time.perf_counter() - t0
-    return {
+    out = {
         "keccak_pallas_hashes_per_sec": round(n / dt, 1),
         "keccak_pallas_checked": True,
     }
+    # Multi-block sponge (config 2's big-shard shape; round-3 item #5):
+    # 272-byte messages absorb 3 blocks.
+    nm = max(256, n // 8)
+    msgs_mb = rng.integers(0, 256, size=(nm, 272), dtype=np.uint8)
+    digests_mb = kp.sha3_256_batch(msgs_mb)
+    for i in (0, nm - 1):
+        assert (
+            digests_mb[i].tobytes()
+            == hashlib.sha3_256(msgs_mb[i].tobytes()).digest()
+        ), "pallas multi-block keccak mismatch vs hashlib"
+    t0 = time.perf_counter()
+    kp.sha3_256_batch(msgs_mb)
+    dt = time.perf_counter() - t0
+    out["keccak_pallas_multiblock_hashes_per_sec"] = round(nm / dt, 1)
+    out["keccak_pallas_multiblock_checked"] = True
+    return out
 
 
 if __name__ == "__main__":

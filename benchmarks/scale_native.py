@@ -1,0 +1,92 @@
+"""Native-engine scale curve past the old 256-node cap (VERDICT #4).
+
+Measures plain QHB epochs at large N on the engine (scalar suite,
+GF(2^16) RBC codec for N > 255, per-width NodeSet builds).  A full
+epoch's message count grows ~N^3 (N RBC instances x N^2 echo/ready
+plus N^2 BA traffic), so wall time explodes with N; to keep runs
+honest AND bounded, each N gets a full epoch if it fits the budget,
+else a steady-state delivery-rate measurement over a fixed window with
+the epoch time EXTRAPOLATED (flagged as such in the JSON).
+
+Env: SCALE_NS (comma list, default "300,512"), SCALE_BUDGET_S per N
+(default 5400), SCALE_WINDOW (rate-window deliveries, default 30M).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+
+def run_n(n: int, budget_s: float, window: int) -> dict:
+    t0 = time.perf_counter()
+    nat = native_engine.NativeQhbNet(n, seed=0, batch_size=8)
+    setup_s = time.perf_counter() - t0
+    for nid in nat.correct_ids:
+        nat.send_input(nid, Input.user(f"tx{nid}"))
+
+    def epoch_done(e) -> bool:
+        return all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids)
+
+    t0 = time.perf_counter()
+    rec = {
+        "config": "scale_native_epoch",
+        "nodes": n,
+        "suite": "scalar",
+        "rbc_codec": "gf2^16" if n > 255 else "gf256",
+        "setup_s": round(setup_s, 2),
+    }
+    chunk = 2_000_000
+    while True:
+        done = nat.run(chunk)
+        elapsed = time.perf_counter() - t0
+        if epoch_done(nat):
+            rec.update(
+                {
+                    "epoch_wall_s": round(elapsed, 1),
+                    "delivered": nat.delivered,
+                    "msgs_per_s": round(nat.delivered / elapsed, 1),
+                    "complete_epoch": True,
+                }
+            )
+            break
+        if done == 0:
+            rec["error"] = "engine idle before epoch completion"
+            break
+        if elapsed > budget_s or nat.delivered >= window:
+            # steady-state rate over the measured window; extrapolation
+            # only, clearly flagged
+            rec.update(
+                {
+                    "delivered": nat.delivered,
+                    "window_wall_s": round(elapsed, 1),
+                    "msgs_per_s": round(nat.delivered / elapsed, 1),
+                    "complete_epoch": False,
+                    "note": "budget/window reached before epoch completion; "
+                    "msgs_per_s is steady-state over the window",
+                }
+            )
+            break
+    faults = sum(len(nat.faults(i)) for i in nat.correct_ids)
+    rec["correct_node_faults"] = faults
+    nat.close()
+    return rec
+
+
+def main() -> None:
+    ns = [int(x) for x in os.environ.get("SCALE_NS", "300,512").split(",")]
+    budget = float(os.environ.get("SCALE_BUDGET_S", "5400"))
+    window = int(os.environ.get("SCALE_WINDOW", "30000000"))
+    for n in ns:
+        print(json.dumps(run_n(n, budget, window)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""TPU first-contact battery (round-3 VERDICT item #2).
+
+THE first action when the axon relay answers: capture every
+hardware-blocked measurement in one serialized pass (the box has ONE
+core — never overlap runs).  Each step is a subprocess with its own
+timeout; every JSON line each step prints is echoed AND appended to
+``BATTERY_r{N}.jsonl`` at the repo root, so a relay window of any
+length yields a durable record of whatever completed.
+
+Steps, in order (cheapest-signal-first so a short window still pays):
+
+1. ``bench.py``            — the headline flush sweep (512/2048/10240
+                             shares) + Pallas-Keccak single/multi-block
+                             probes (never yet executed on hardware).
+2. config5 firehose        — 10k-share verify batches, the BASELINE
+                             config 5 scaling axis.
+3. config3 native BLS @tpu — the fused stack (native loop + TpuBackend
+                             flush): N=16, real BLS, epoch latency +
+                             verifies/flush.  Reduced to 64 tx / 64
+                             batch for the first hardware contact (one
+                             TPU flush compile is already minutes cold);
+                             rerun with BENCH_TXNS=256 once warm.
+
+Run: ``python benchmarks/tpu_battery.py`` (optionally
+``BATTERY_TAG=r03``).  A TPU probe gates the whole battery: if the
+relay is down it emits one JSON line saying so and exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def probe_tpu(timeout_s: float = 60.0) -> tuple[bool, str]:
+    """Subprocess probe (in-process jax.devices() hangs when the relay
+    is down — see CLAUDE.md)."""
+    code = "import jax; ds = jax.devices(); print(ds[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init timed out after {timeout_s:.0f}s (relay down?)"
+    if r.returncode != 0:
+        return False, (r.stderr or "probe failed").strip()[-300:]
+    plat = (r.stdout or "").strip().splitlines()[-1] if r.stdout else ""
+    if plat not in ("tpu", "axon"):
+        return False, f"platform is {plat!r}, not tpu"
+    return True, plat
+
+
+def run_step(name: str, argv: list[str], env: dict, timeout_s: float, sink) -> None:
+    t0 = time.monotonic()
+    rec = {"step": name, "argv": argv}
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+            env={**os.environ, **env},
+        )
+        rec["rc"] = r.returncode
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        lines = []
+        for line in (r.stdout or "").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                lines.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+        rec["results"] = lines
+        if r.returncode != 0:
+            rec["stderr_tail"] = (r.stderr or "")[-400:]
+    except subprocess.TimeoutExpired as e:
+        rec["rc"] = -1
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        rec["error"] = f"timeout after {timeout_s:.0f}s"
+        partial = (e.stdout or b"")
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        rec["stdout_tail"] = partial[-400:]
+    print(json.dumps(rec), flush=True)
+    sink.write(json.dumps(rec) + "\n")
+    sink.flush()
+
+
+def main() -> None:
+    tag = os.environ.get("BATTERY_TAG", "r03")
+    out_path = os.path.join(ROOT, f"BATTERY_{tag}.jsonl")
+    ok, note = probe_tpu()
+    with open(out_path, "a") as sink:
+        head = {
+            "step": "probe",
+            "tpu": ok,
+            "note": note,
+            "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+        print(json.dumps(head), flush=True)
+        sink.write(json.dumps(head) + "\n")
+        sink.flush()
+        if not ok:
+            return
+        py = sys.executable
+        run_step(
+            "bench_flush_sweep", [py, "bench.py"],
+            {"BENCH_DEADLINE_S": "900"}, 1200, sink,
+        )
+        run_step(
+            "config5_firehose", [py, "benchmarks/config5_firehose.py"],
+            {}, 1200, sink,
+        )
+        run_step(
+            "config3_native_bls_tpu", [py, "benchmarks/config3_native_bls.py"],
+            {"BENCH_BACKEND": "tpu", "BENCH_TXNS": "64", "BENCH_BATCH": "64"},
+            1800, sink,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -215,8 +215,13 @@ class TpuBackend(CryptoBackend):
         n1 = _bucket(max(len(g1e), 1))
         n2 = _bucket(max(len(g2e), 1))
         # Legs become pairing-product pairs (a Miller loop each, even when
-        # identity-skipped), so keep their floor low.
-        nl = _bucket(max(len(rhs), 1), floor=2)
+        # identity-skipped).  Floor 8: identity-padded legs cost sub-ms
+        # device compute, while every DISTINCT leg bucket costs a fresh
+        # minutes-long kernel compile — bisection over a failing batch
+        # otherwise compiles 2/4/8-leg kernels separately (the round-3
+        # cold-cache audit measured ~7 min per flush-kernel compile on
+        # the virtual-CPU platform).
+        nl = _bucket(max(len(rhs), 1), floor=8)
         ident1 = (1, 1, 0)
         ident2 = ((1, 0), (1, 0), (0, 0))
         g1_pts = dcurve.g1_to_dev(

@@ -180,6 +180,11 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     ]
     lib.hbe_queue_dest.restype = ctypes.c_int32
     lib.hbe_queue_dest.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    # delivery profiling counters (BASELINE.md round-3 workflow)
+    for name in ("hbe_prof_cycles", "hbe_prof_count"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.hbe_pending_verifies.restype = ctypes.c_uint64
     lib.hbe_pending_verifies.argtypes = [ctypes.c_void_p]
     lib.hbe_flush.argtypes = [ctypes.c_void_p]

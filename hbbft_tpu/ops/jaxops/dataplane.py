@@ -15,8 +15,10 @@ This module runs the whole thing as three device ops:
 
 Bit-exact with the host path (``ops.merkle.MerkleTree`` /
 ``ops.gf256.ReedSolomon``) — proofs produced here validate against the
-same roots.  Device Keccak is single-block, so the path requires
-``shard_len + 1 <= 135`` bytes; larger shards use the host data plane.
+same roots.  Device Keccak absorbs multi-block since round 3, so big
+shards (config 2's 10-node/1 KB shape packs to 129-byte shards) ride
+the device path too; ``MAX_DEV_SHARD`` only bounds the unrolled block
+count of one call.
 """
 
 from __future__ import annotations
@@ -31,7 +33,9 @@ from hbbft_tpu.ops.jaxops import keccak as jk
 from hbbft_tpu.ops.merkle import Proof, _depth
 
 
-MAX_DEV_SHARD = jk.RATE - 2 - 32  # leaf prefix + padding headroom
+# Device-path shard bound: multi-block absorption handles any length;
+# this only caps the per-call permutation count (16 blocks ~= 2 KB).
+MAX_DEV_SHARD = 16 * jk.RATE - 2
 
 
 def _pack(value: bytes, k: int) -> Tuple[np.ndarray, int]:
@@ -53,8 +57,8 @@ def encode_and_prove(
     Returns ``proofs[v][i]`` — the proof of value v's shard i, exactly
     what ``Broadcast`` sends node i as its ``Value`` message.  All
     values must pack to one common shard length (callers batch by size
-    bucket); for the device Keccak path that length must be
-    <= ``MAX_DEV_SHARD`` (101) bytes.
+    bucket); the device Keccak absorbs multi-block, so any length up to
+    ``MAX_DEV_SHARD`` (the per-call block-count bound) is eligible.
     """
     assert values, "empty batch"
     packs = [_pack(v, k) for v in values]

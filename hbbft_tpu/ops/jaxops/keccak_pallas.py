@@ -147,13 +147,39 @@ def sha3_256_block(padded: np.ndarray, interpret: bool = False) -> np.ndarray:
     return dig
 
 
-def sha3_256_batch(msgs: np.ndarray, interpret: bool = False) -> np.ndarray:
-    """Batched single-block SHA3-256 via the Pallas permutation.
+def sha3_256_multi(padded: np.ndarray, interpret: bool = False) -> np.ndarray:
+    """(batch, n_blocks*RATE) padded messages -> (batch, 32) digests.
 
-    (batch, m <= RATE-1) uint8 -> (batch, 32) uint8; bit-identical to
-    jaxops.keccak.sha3_256_batch and hashlib.
+    Multi-block sponge: XOR-absorb each block into the (50, batch)
+    column state and run the fused Pallas permutation per block.
     """
-    from hbbft_tpu.ops.jaxops.keccak import pad_block
+    from hbbft_tpu.ops.jaxops.keccak import block_words, digest_from_state
 
-    return sha3_256_block(pad_block(np.asarray(msgs, dtype=np.uint8)),
-                          interpret=interpret)
+    padded = np.asarray(padded, dtype=np.uint8)
+    batch, total = padded.shape
+    nb = total // RATE
+    state = jnp.zeros((50, batch), dtype=jnp.uint32)
+    for b in range(nb):
+        words = block_words(padded[:, b * RATE : (b + 1) * RATE])  # (batch, 17, 2)
+        cols = np.zeros((50, batch), dtype=np.uint32)
+        cols[0 : 2 * (RATE // 8) : 2] = words[:, :, 0].T
+        cols[1 : 2 * (RATE // 8) : 2] = words[:, :, 1].T
+        state = keccak_f_cols(state ^ jnp.asarray(cols), interpret=interpret)
+    out = np.asarray(state)  # (50, batch)
+    lanes = np.stack([out[0::2].T, out[1::2].T], axis=-1)  # (batch, 25, 2)
+    return digest_from_state(lanes)
+
+
+def sha3_256_batch(msgs: np.ndarray, interpret: bool = False) -> np.ndarray:
+    """Batched SHA3-256 via the Pallas permutation.
+
+    (batch, m) uint8 -> (batch, 32) uint8; bit-identical to
+    jaxops.keccak.sha3_256_batch and hashlib.  Single-block messages
+    take the one-permutation path; longer ones absorb block by block.
+    """
+    from hbbft_tpu.ops.jaxops.keccak import pad_block, pad_multi
+
+    msgs = np.asarray(msgs, dtype=np.uint8)
+    if msgs.shape[1] <= RATE - 1:
+        return sha3_256_block(pad_block(msgs), interpret=interpret)
+    return sha3_256_multi(pad_multi(msgs), interpret=interpret)

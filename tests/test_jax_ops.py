@@ -149,3 +149,39 @@ def test_dataplane_rs_bitmatmul_sharded_over_mesh(rng):
         full = rs.encode([bytes([data[r, c]]) for r in range(k)])
         for p in range(n - k):
             assert parity_bytes[p, c] == full[k + p][0]
+
+
+def test_sha3_multiblock_matches_hashlib(rng):
+    """Multi-block sponge absorption (round 3): any equal length, incl.
+    the exact block-boundary edge cases, matches hashlib bit-for-bit."""
+    for m in (136, 137, 200, 271, 272, 273, 500, 1024):
+        msgs = rng.integers(0, 256, size=(4, m), dtype=np.uint8)
+        got = jk.sha3_256_batch(msgs)
+        for i in range(4):
+            assert bytes(got[i]) == hashlib.sha3_256(bytes(msgs[i])).digest(), m
+
+
+def test_dataplane_config2_shape_rides_device_path(rng):
+    """Config 2's canonical shape (10 nodes, 1 KB payload -> 129-byte
+    shards) must use the device data plane (round-2 VERDICT item #5) and
+    produce proofs identical to the host path."""
+    from hbbft_tpu.ops.jaxops import dataplane as dp
+    from hbbft_tpu.ops.merkle import MerkleTree
+    from hbbft_tpu.protocols.broadcast import _pack
+
+    k, n = 4, 10  # f=3 -> k = n - 2f
+    value = bytes(rng.integers(0, 256, 1000, dtype=np.uint8))
+    _, shard_len = dp._pack(value, k)
+    assert shard_len > jk.RATE - 2 - 32, "shape must exceed one block"
+    assert shard_len <= dp.MAX_DEV_SHARD, "config-2 shape must be device-eligible"
+    proofs = dp.encode_and_prove([value], k, n)[0]
+    # host reference: same RS + Merkle pipeline
+    host_shards = host_gf.ReedSolomon(k, n).encode(list(_pack(value, k)))
+    tree = MerkleTree(host_shards)
+    for i in range(n):
+        want = tree.proof(i)
+        assert proofs[i].value == want.value
+        assert proofs[i].index == want.index
+        assert tuple(proofs[i].path) == tuple(want.path)
+        assert proofs[i].root == want.root
+        assert want.validate(n)

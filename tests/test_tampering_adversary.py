@@ -188,3 +188,79 @@ def test_replay_adversary_on_threshold_sign(seed):
     outs = [net.node(i).outputs[0] for i in net.correct_ids]
     assert all(o == outs[0] for o in outs)
     assert net.correct_faults() == []
+
+
+@pytest.mark.parametrize("seed", [31, 32, 33, 34, 35])
+def test_era_change_under_tampering(seed):
+    """A full DHB era change (votes -> embedded DKG -> restart) with a
+    tampering faulty validator rewriting its outgoing streams (round-3
+    VERDICT item #7): correct nodes must complete the era change and
+    agree batch-for-batch; fault logs must only name faulty ids; no
+    raise paths."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
+    from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+    net = (
+        NetBuilder(4, seed=seed)
+        .num_faulty(1)
+        .max_cranks(3_000_000)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(ni, sink, batch_size=8)
+        )
+        .adversary(TamperingAdversary(tamper_p=0.5))
+        .build()
+    )
+    # vote out the last CORRECT validator (id 2), keeping 3 >= 3f+1
+    # impossible at f=1... so instead vote out the FAULTY validator (3):
+    # the era change must complete even though the departing node is the
+    # tamperer.
+    keep = dict(net.node(0).netinfo.public_key_map)
+    keep.pop(net.faulty_ids[0])
+    change = Change.node_change(keep)
+    for nid in net.correct_ids:
+        net.send_input(nid, Input.change(change))
+
+    def batches(n, nid):
+        return [o for o in n.node(nid).outputs if isinstance(o, DhbBatch)]
+
+    def change_complete(n):
+        return all(
+            any(b.change.kind == "complete" for b in batches(n, i))
+            for i in n.correct_ids
+        )
+
+    for r in range(10):
+        if change_complete(net):
+            break
+        for nid in net.correct_ids:
+            net.send_input(nid, Input.user(f"era-tx-{r}-{nid}"))
+        want = r + 1
+        net.crank_until(
+            lambda n, w=want: all(
+                len(batches(n, i)) >= w for i in n.correct_ids
+            ),
+            max_cranks=3_000_000,
+        )
+    assert change_complete(net), "era change did not complete under tampering"
+    # all correct nodes agree on the whole batch sequence (common prefix)
+    seqs = {
+        i: [
+            (b.era, b.epoch, b.contributions, b.change.kind)
+            for b in batches(net, i)
+        ]
+        for i in net.correct_ids
+    }
+    shortest = min(len(s) for s in seqs.values())
+    first = next(iter(seqs.values()))[:shortest]
+    assert all(s[:shortest] == first for s in seqs.values())
+    # the new era actually started and the departed (faulty) node is out
+    eras = {net.node(i).protocol.dhb.era for i in net.correct_ids}
+    assert eras == {1}, eras
+    new_sets = {
+        tuple(net.node(i).protocol.dhb._netinfo.all_ids)
+        for i in net.correct_ids
+    }
+    assert new_sets == {tuple(sorted(keep))}
+    # fault logs of correct nodes may only name faulty ids
+    assert net.correct_faults() == []
+    assert faulty_fault_ids(net) <= set(net.faulty_ids)
