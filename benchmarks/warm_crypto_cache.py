@@ -44,38 +44,50 @@ def main() -> None:
     msg = b"warmup"
     backend = TpuBackend(suite)
 
-    # The canonical test-tier bucket: (16, 16, 8) — a small mixed batch
-    # (sig shares + ciphertext + decryption share) lands exactly here,
-    # and every bisection sub-batch shares it thanks to the floors.
-    t0 = time.time()
-    reqs = []
-    for i in range(3):
-        share = sks.secret_key_share(i % 2).sign(msg)
-        reqs.append(VerifyRequest.sig_share(pks.public_key_share(i % 2), msg, share))
-    ct = pks.public_key().encrypt(b"warm-ct", rng)
-    reqs.append(VerifyRequest.ciphertext(ct))
-    reqs.append(
-        VerifyRequest.dec_share(
-            pks.public_key_share(0),
-            ct,
-            sks.secret_key_share(0).decryption_share(ct),
-        )
-    )
-    ok = backend.verify_batch(reqs)
-    assert all(ok), ok
-    log(f"flush kernel bucket warmed in {time.time() - t0:.0f}s")
-
-    # Bisection fallback path (compiles nothing new if the floors hold,
-    # and pins that property).
-    t0 = time.time()
+    # Warm each legs bucket the heavy tier touches (floor=2: buckets
+    # 2/4/8 — the test-tier mixed batches land in nl=8, bisection
+    # sub-batches in nl=4 and nl=2).  One distinct-leg batch per bucket.
     from hbbft_tpu.crypto.keys import SignatureShare
 
+    def sig(i: int, m: bytes) -> VerifyRequest:
+        return VerifyRequest.sig_share(
+            pks.public_key_share(i % 2), m, sks.secret_key_share(i % 2).sign(m)
+        )
+
+    ct = pks.public_key().encrypt(b"warm-ct", rng)
+    batches = {
+        # nl=2: generator leg + one message-hash leg
+        2: [sig(0, msg), sig(1, msg)],
+        # nl=4 (3 legs): + a second distinct message
+        4: [sig(0, msg), sig(1, b"warm-doc-2")],
+        # nl=8 (5 legs): + ciphertext check + decryption share
+        8: [
+            sig(0, msg),
+            sig(1, b"warm-doc-2"),
+            VerifyRequest.ciphertext(ct),
+            VerifyRequest.dec_share(
+                pks.public_key_share(0),
+                ct,
+                sks.secret_key_share(0).decryption_share(ct),
+            ),
+        ],
+    }
+    for nl, reqs in sorted(batches.items()):
+        t0 = time.time()
+        ok = backend.verify_batch(reqs)
+        assert all(ok), (nl, ok)
+        log(f"flush kernel legs-bucket nl={nl} warmed in {time.time() - t0:.0f}s")
+
+    # Bisection fallback: a bad share forces the aggregate to split; the
+    # sub-batches reuse the buckets warmed above.
+    t0 = time.time()
     bad = VerifyRequest.sig_share(
         pks.public_key_share(0), msg, SignatureShare(suite.g2_generator(), suite)
     )
-    res = backend.verify_batch(reqs + [bad])
-    assert res[:-1] == [True] * len(reqs) and res[-1] is False
-    log(f"bisection path warmed in {time.time() - t0:.0f}s (shared bucket)")
+    reqs8 = batches[8]
+    res = backend.verify_batch(reqs8 + [bad])
+    assert res[:-1] == [True] * len(reqs8) and res[-1] is False
+    log(f"bisection path exercised in {time.time() - t0:.0f}s")
     log("done")
 
 

@@ -18,6 +18,8 @@ Three fidelity pins:
    throughout, SURVEY.md §2 #14).
 """
 
+import os
+
 import pytest
 
 from hbbft_tpu import native_engine
@@ -111,7 +113,7 @@ def test_ext_scalar_era_change():
             4, seed=11, batch_size=BATCH_SIZE, num_faulty=0, session_id=SESSION,
             **kw,
         )
-        keep = dict(nat.nodes[0].qhb.dhb._netinfo.public_key_map)
+        keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
         keep.pop(3)
         change = Change.node_change(keep)
         for nid in range(4):
@@ -234,3 +236,55 @@ def test_bls_native_deferred_flush_amortizes():
     # Cross-node dedup: identical requests observed by several nodes hit
     # the backend once.
     assert stats["backend_requests"] < stats["requests"], stats
+
+
+@pytest.mark.skipif(
+    not os.environ.get("HBBFT_TPU_BLS_ERA"),
+    reason="slow tier: set HBBFT_TPU_BLS_ERA=1 (full real-BLS era change, ~minutes)",
+)
+def test_bls_native_era_change():
+    """The fused stack through a COMPLETE era change with real BLS12-381:
+    votes sign/verify, the embedded DKG deals real BivarPoly rows over
+    real KEM ciphertexts, and the new era's threshold keys come out of
+    the distributed generation — all under the native message loop."""
+    from hbbft_tpu.crypto.bls import BLSSuite
+    from hbbft_tpu.protocols.dynamic_honey_badger import Change
+
+    n = 4
+    nat = native_engine.NativeQhbNet(
+        n, seed=2, batch_size=BATCH_SIZE, num_faulty=0, session_id=SESSION,
+        suite=BLSSuite(), flush_every=0,
+    )
+    keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+    keep.pop(n - 1)
+    for nid in range(n):
+        nat.send_input(nid, Input.change(Change.node_change(keep)))
+
+    def done(e):
+        return all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        )
+
+    for r in range(8):
+        if done(nat):
+            break
+        for nid in range(n):
+            nat.send_input(nid, Input.user(f"e{r}-{nid}"))
+        want = len(nat.nodes[0].outputs) + 1
+        nat.run_until(
+            lambda e, w=want: all(
+                len(e.nodes[i].outputs) >= w for i in e.correct_ids
+            ),
+            chunk=2000,
+        )
+    assert done(nat)
+    assert {nat.nodes[i].qhb.dhb.era for i in nat.correct_ids} == {1}
+    # all nodes derived the SAME new master key from the DKG
+    new_pks = {
+        nat.nodes[i].qhb.dhb.netinfo.public_key_set.to_bytes()
+        for i in nat.correct_ids
+    }
+    assert len(new_pks) == 1
+    assert all(nat.faults(i) == [] for i in range(n))
+    nat.close()
