@@ -293,7 +293,10 @@ def _on_curve_and_torsion(
         return False
     if not check_subgroup or elem._subgroup_ok:
         return True
-    ok = C.jac_is_identity(ops, C.jac_mul(ops, jac, F.R))
+    # Endomorphism membership tests (curve.py): ~2x (G1) / ~4x (G2)
+    # fewer group ops than the definitional [r]P == O, same verdict
+    # (equivalence pinned by tests/test_bls.py against in_subgroup_slow).
+    ok = C.g2_in_subgroup(jac) if ops is C.FQ2_OPS else C.g1_in_subgroup(jac)
     if ok:
         elem._subgroup_ok = True
     return ok

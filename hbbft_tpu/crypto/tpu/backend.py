@@ -7,11 +7,14 @@ the heavy group algebra runs on the accelerator in ONE jitted kernel:
 
 * every share/key/ciphertext point is scaled by its 128-bit RLC
   coefficient with a batched LSB-first double-and-add scan that
-  SIMULTANEOUSLY computes ``[r-1]P`` off the same doubling chain — the
-  subgroup (r-torsion) check for wire-sourced points runs on device,
-  batched, instead of as per-request Python scalar-mults on the host
-  (which cost more than the entire device flush: BASELINE.md round-1
-  measurements),
+  SIMULTANEOUSLY computes the endomorphism-check chain (``[x^2]P`` on
+  G1, ``[|x|]Q`` on G2 — both fit the same 128-bit width) off the same
+  doubling chain — the subgroup (r-torsion) check for wire-sourced
+  points runs on device as the standard phi/psi endomorphism tests
+  (``bls.curve.g1_in_subgroup`` notes), batched, instead of as
+  per-request Python scalar-mults on the host (which cost more than
+  the entire device flush: BASELINE.md round-1 measurements); the
+  endomorphism form halves the scan vs the round-2 ``[r-1]P`` chain,
 * per-leg sums are masked tree reductions,
 * the 1 + L pairing-product legs run through the batched Miller loop and
   one shared final exponentiation.
@@ -75,11 +78,11 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
     """Compiled flush kernel for one shape bucket.
 
     Inputs (all device arrays):
-      g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, RM1_NBITS;
-      the 128-bit RLC coefficient zero-padded to the torsion width),
-      g1 subgroup-check mask (n_g1,), g1 leg one-hot (n_legs, n_g1);
-      g2 pts / bits / mask (n_g2 …) — the generator leg;
-      rhs G2 points (n_legs) to pair each G1 leg sum with.
+      g1 pts (n_g1 batched G1 Jacobian+flag), g1 bits (n_g1, ENDO_NBITS
+      = 128; the RLC coefficient), g1 subgroup-check mask (n_g1,), g1
+      leg one-hot (n_legs, n_g1); g2 pts / bits / mask (n_g2 …) — the
+      generator leg; rhs G2 points (n_legs) to pair each G1 leg sum
+      with.
     Returns the single aggregate boolean: RLC pairing product == 1 AND
     every masked wire-sourced point passes the batched r-torsion check
     (the host only does structural/on-curve validation — a Python
@@ -88,21 +91,17 @@ def _kernel(n_g1: int, n_g2: int, n_legs: int):
 
     def run(g1_pts, g1_bits, g1_chk, seg, g2_pts, g2_bits, g2_chk, rhs_g2, gen_pt):
         # One LSB-first shared-doubling scan per group computes the RLC
-        # multiple AND [r-1]P together; bits are RM1_NBITS wide.
-        rm1_1 = jnp.broadcast_to(
-            jnp.asarray(dcurve.RM1_BITS_LSB), (n_g1, dcurve.RM1_NBITS)
-        )
-        rm1_2 = jnp.broadcast_to(
-            jnp.asarray(dcurve.RM1_BITS_LSB), (n_g2, dcurve.RM1_NBITS)
-        )
-        scaled1, tor1 = dcurve.scalar_mul2(dcurve.G1_OPS, g1_pts, g1_bits, rm1_1)
-        scaled2, tor2 = dcurve.scalar_mul2(dcurve.G2_OPS, g2_pts, g2_bits, rm1_2)
-        sub1 = dcurve.jac_eq_dev(
-            dcurve.G1_OPS, tor1, dcurve.neg(dcurve.G1_OPS, g1_pts)
-        )
-        sub2 = dcurve.jac_eq_dev(
-            dcurve.G2_OPS, tor2, dcurve.neg(dcurve.G2_OPS, g2_pts)
-        )
+        # multiple AND the endomorphism-check chain ([x^2]P on G1, [|x|]Q
+        # on G2) together — both scalars fit ENDO_NBITS = 128 bits, vs
+        # the 255-step [r-1]P chain this replaced (see dcurve endo notes;
+        # equivalence + soundness pinned in tests/test_bls.py and
+        # tests/test_tpu_crypto.py).
+        endo1 = jnp.asarray(dcurve.endo_bits(False, n_g1))
+        endo2 = jnp.asarray(dcurve.endo_bits(True, n_g2))
+        scaled1, chain1 = dcurve.scalar_mul2(dcurve.G1_OPS, g1_pts, g1_bits, endo1)
+        scaled2, chain2 = dcurve.scalar_mul2(dcurve.G2_OPS, g2_pts, g2_bits, endo2)
+        sub1 = dcurve.endo_subgroup_eq(dcurve.G1_OPS, g1_pts, chain1)
+        sub2 = dcurve.endo_subgroup_eq(dcurve.G2_OPS, g2_pts, chain2)
         sub_ok = jnp.all(sub1 | (g1_chk == 0)) & jnp.all(sub2 | (g2_chk == 0))
         gen_leg = dcurve.tree_sum(dcurve.G2_OPS, scaled2)
         leg_sums = []
@@ -229,7 +228,7 @@ class TpuBackend(CryptoBackend):
             [p for _, p, _, _ in g1e] + [ident1] * (n1 - len(g1e))
         )
         g1_bits = dcurve.scalars_to_bits_lsb(
-            [s for s, _, _, _ in g1e] + [0] * (n1 - len(g1e)), dcurve.RM1_NBITS
+            [s for s, _, _, _ in g1e] + [0] * (n1 - len(g1e)), dcurve.ENDO_NBITS
         )
         g1_chk = np.zeros(n1, dtype=np.int32)
         seg = np.zeros((nl, n1), dtype=np.int32)
@@ -240,7 +239,7 @@ class TpuBackend(CryptoBackend):
             [p for _, p, _ in g2e] + [ident2] * (n2 - len(g2e))
         )
         g2_bits = dcurve.scalars_to_bits_lsb(
-            [s for s, _, _ in g2e] + [0] * (n2 - len(g2e)), dcurve.RM1_NBITS
+            [s for s, _, _ in g2e] + [0] * (n2 - len(g2e)), dcurve.ENDO_NBITS
         )
         g2_chk = np.zeros(n2, dtype=np.int32)
         for i, (_, _, chk) in enumerate(g2e):
@@ -278,6 +277,13 @@ class TpuBackend(CryptoBackend):
 
     # -- public API ----------------------------------------------------
 
+    # Per-flush device sweet spot (measured, TPU v5e, BASELINE.md round-3
+    # battery): the 16384-row bucket costs ~1.8x more per ROW than 2048
+    # (scan working set vs HBM), and power-of-two padding above the chunk
+    # wastes up to 60% of rows — so giant flushes are split and verified
+    # chunk-by-chunk, each with its own Fiat-Shamir coefficients.
+    CHUNK = 4096
+
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
         reqs = list(reqs)
         if not reqs:
@@ -290,7 +296,8 @@ class TpuBackend(CryptoBackend):
             for i, r in enumerate(reqs)
             if request_well_formed(self.suite, r, subgroup=False)
         ]
-        self._verify_range(reqs, idxs, out)
+        for s in range(0, len(idxs), self.CHUNK):
+            self._verify_range(reqs, idxs[s : s + self.CHUNK], out)
         return out
 
     def _verify_range(

@@ -28,6 +28,7 @@ for tests and cold paths.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Callable, Tuple
 
 import jax
@@ -205,30 +206,86 @@ def jac_eq_dev(ops: Ops, p: Point, q: Point) -> jnp.ndarray:
     return both_inf | (both_fin & z_ok & ex & ey)
 
 
-# Bits of r-1 (r = subgroup order).  [r-1]P == -P iff P is in the
-# r-torsion subgroup; the double-and-add prefixes of r-1 never hit
-# add_unsafe's forbidden cases for subgroup points: the unsafe add
-# add([2k]P, P) needs 2k ≡ ±1 (mod r), and every prefix satisfies
-# 2k + 1 <= r-1 with 2k even, so neither branch can occur.
-RM1_NBITS = (F.R - 1).bit_length()  # 255
-_RM1_BITS = np.asarray(
-    [(int(F.R - 1) >> i) & 1 for i in reversed(range(RM1_NBITS))],
-    dtype=np.int32,
-)
-RM1_BITS_LSB = _RM1_BITS[::-1].copy()
+# ---------------------------------------------------------------------------
+# Endomorphism subgroup checks — device mirror of bls.curve.g1_in_subgroup /
+# g2_in_subgroup (see the derivation + soundness notes there and the
+# equivalence/soundness tests in tests/test_bls.py, tests/test_tpu_crypto.py).
+#
+#   G1: phi(P) == -[x^2]P   (phi: X *= beta; x^2 is 127 bits)
+#   G2: psi(Q) == -[|x|]Q   (psi: conjugate coords, X *= cx, Y *= cy)
+#
+# Both scalars fit the 128-bit RLC coefficient width, so the flush
+# kernel's shared-doubling scan drops from the 255-step [r-1]P chain
+# (the round-2 design) to 128 steps.
+#
+# Fail-closed safety with add_unsafe: an adversarial SMALL-ORDER point
+# can steer the fixed-scalar chain into add_unsafe's forbidden P == ±Q
+# cases, but those produce z = 0 outputs and z stays 0 through every
+# subsequent double/add (z3 always carries a factor of the incoming z),
+# and jac_eq_dev treats unflagged z == 0 as UNEQUAL — so a corrupted
+# chain can only REJECT, which is the correct verdict for any point
+# that could steer it (subgroup points can't: the prefix-coincidence
+# argument in scalar_mul2's docstring).
+# ---------------------------------------------------------------------------
+
+ENDO_NBITS = 128
 
 
-def subgroup_check(ops: Ops, pts: Point) -> jnp.ndarray:
-    """Batched r-torsion membership: [r-1]P == -P (True for identity).
+@lru_cache(maxsize=1)
+def _endo_consts():
+    """(beta_mont, psi_cx_mont, psi_cy_mont, x2_bits, xabs_bits) — device
+    forms of the oracle-derived endomorphism constants."""
+    from hbbft_tpu.crypto.bls import curve as OC
 
-    Replaces the reference's per-point CPU subgroup validation (pairing
-    crate ``is_torsion_free``-style checks) with one batched 255-bit
-    scalar multiplication.
-    """
-    n = pts[0].shape[0]
-    bits = jnp.broadcast_to(jnp.asarray(_RM1_BITS), (n, _RM1_BITS.shape[0]))
-    q = scalar_mul(ops, pts, bits)
-    return jac_eq_dev(ops, q, neg(ops, pts))
+    x_abs = -F.BLS_X
+    beta = fq.to_mont_np(OC.g1_beta())
+    cx, cy = OC.psi_consts()
+    x2_bits = _scalars_to_bits_np([x_abs * x_abs], ENDO_NBITS)[0]
+    xabs_bits = _scalars_to_bits_np([x_abs], ENDO_NBITS)[0]
+    return (
+        beta,
+        fq2.to_mont_np(cx),
+        fq2.to_mont_np(cy),
+        x2_bits,
+        xabs_bits,
+    )
+
+
+def endo_bits(g2: bool, n: int) -> np.ndarray:
+    """(n, ENDO_NBITS) LSB-first bits of the endomorphism-check scalar
+    (x^2 for G1 rows, |x| for G2 rows) — the bits_b of the shared scan."""
+    _, _, _, x2_bits, xabs_bits = _endo_consts()
+    return np.broadcast_to(xabs_bits if g2 else x2_bits, (n, ENDO_NBITS))
+
+
+def phi_g1(p: Point) -> Point:
+    """GLV endomorphism on batched G1 Jacobian points: X *= beta."""
+    beta, _, _, _, _ = _endo_consts()
+    x, y, z, inf = p
+    bx = fq.mont_mul(x, jnp.broadcast_to(jnp.asarray(beta), x.shape))
+    return (bx, y, z, inf)
+
+
+def psi_g2(p: Point) -> Point:
+    """Untwist-Frobenius-twist on batched G2 Jacobian points:
+    (cx*conj(X), cy*conj(Y), conj(Z))."""
+    _, cx, cy, _, _ = _endo_consts()
+    x, y, z, inf = p
+    cxb = jnp.broadcast_to(jnp.asarray(cx), x.shape)
+    cyb = jnp.broadcast_to(jnp.asarray(cy), y.shape)
+    return (
+        fq2.mul(cxb, fq2.conj(x)),
+        fq2.mul(cyb, fq2.conj(y)),
+        fq2.conj(z),
+        inf,
+    )
+
+
+def endo_subgroup_eq(ops: Ops, pts: Point, chain_out: Point) -> jnp.ndarray:
+    """Batched membership verdicts given ``chain_out`` = [x^2]P (G1) or
+    [|x|]Q (G2) from the shared scan: endo(P) == -chain_out."""
+    endo = psi_g2(pts) if ops is G2_OPS else phi_g1(pts)
+    return jac_eq_dev(ops, endo, neg(ops, chain_out))
 
 
 def scalar_mul2(
@@ -246,8 +303,17 @@ def scalar_mul2(
     add_unsafe safety (on top of the module-docstring argument): the
     accumulator after k steps holds ``(m mod 2^k)·P`` (fixed scalar) or a
     committed-coefficient partial sum (Fiat-Shamir), and the addend is
-    ``2^k·P``; coincidence needs m mod 2^k ≡ ±2^k (mod r), impossible
-    for m = r-1 and negligible for random coefficients.
+    ``2^k·P``; coincidence needs m mod 2^k ≡ ±2^k (mod r).  For any
+    FIXED m < 2^128 over k ≤ 128 steps (the RLC coefficients and both
+    endomorphism-chain scalars x^2 and |x| qualify) that is impossible:
+    m mod 2^k < 2^k rules out +2^k as integers, and -2^k mod r =
+    r - 2^k > 2^128 > m mod 2^k rules out the negative case; the same
+    bounds covered the historic m = r-1 chain.  For small-ORDER inputs
+    (adversarial non-subgroup points, where the arithmetic is mod
+    ord(P), not r) a coincidence CAN occur, but then z becomes and
+    stays 0, ``jac_eq_dev`` reports unequal, and the membership check
+    fails closed — rejection being the right verdict for any point able
+    to steer the chain (see the endo section notes above).
     """
     assert bits_a.shape == bits_b.shape
     batch = bits_a.shape[:-1]

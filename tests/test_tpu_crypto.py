@@ -279,12 +279,10 @@ def test_tpu_backend_isolates_bad_shares():
 
 @heavy_compile
 def test_device_subgroup_check_and_rejection():
-    """The batched r-torsion check accepts subgroup points/identity and
-    rejects on-curve points outside the subgroup; TpuBackend rejects a
-    share forged from a non-subgroup point (host does only structural
-    checks — the torsion check lives in the kernel)."""
-    import hashlib as _h
-
+    """TpuBackend rejects a share forged from a non-subgroup point (the
+    host does only structural checks — the membership test lives in the
+    kernel as the batched endomorphism chain; its direct device-vs-
+    oracle pin is test_device_endo_subgroup_matches_oracle)."""
     from hbbft_tpu.crypto.bls.suite import G2Elem
     from hbbft_tpu.crypto.keys import SignatureShare
 
@@ -295,12 +293,6 @@ def test_device_subgroup_check_and_rejection():
     rogue = G2Elem(pt)
     assert suite.is_g2(rogue, check_subgroup=False)
     assert not suite.is_g2(rogue)  # oracle agrees it's outside
-
-    gen = suite.g2_generator()
-    pts = dc.g2_to_dev([rogue.jac, gen.jac, (gen * 12345).jac,
-                        suite.g2_identity().jac])
-    ok = np.asarray(dc.subgroup_check(dc.G2_OPS, pts))
-    assert list(ok) == [False, True, True, True]
 
     # End-to-end: a forged share built on the rogue point must fail in
     # TpuBackend (and the honest shares around it must still pass).
@@ -347,3 +339,45 @@ def test_tpu_backend_sharded_flush_matches():
     want = [True] * 16
     want[5] = False
     assert got == want
+
+
+@heavy_compile
+def test_device_endo_subgroup_matches_oracle():
+    """The 128-step endomorphism membership chain (the flush kernel's
+    round-3 subgroup check) agrees with the oracle on G1 and G2 for
+    members, non-members, and the identity."""
+    suite = BLSSuite()
+    gen2 = suite.g2_generator()
+    rogue2 = oc._twist_sample_point()  # on E'(Fq2), outside G2
+    cof2 = oc.jac_mul(oc.FQ2_OPS, rogue2, OF.R)  # order | h2
+    g2_jacs = [rogue2, cof2, gen2.jac, (gen2 * 9999).jac,
+               suite.g2_identity().jac]
+    pts2 = dc.g2_to_dev(g2_jacs)
+    n2 = len(g2_jacs)
+    bits_dummy = jnp.zeros((n2, dc.ENDO_NBITS), jnp.int32)
+    endo2 = jnp.asarray(dc.endo_bits(True, n2))
+    _, chain2 = dc.scalar_mul2(dc.G2_OPS, pts2, bits_dummy, endo2)
+    ok2 = np.asarray(dc.endo_subgroup_eq(dc.G2_OPS, pts2, chain2))
+    want2 = [oc.g2_in_subgroup(j) for j in g2_jacs]
+    assert list(map(bool, ok2)) == want2 == [False, False, True, True, True]
+
+    gen1 = suite.g1_generator()
+    # an E(Fq) point outside G1: search a curve x, clear nothing
+    x = 1
+    while True:
+        rhs = (x * x * x + oc.B1) % P
+        y = pow(rhs, (P + 1) // 4, P)
+        if y * y % P == rhs and not oc.g1_in_subgroup((x, y, 1)):
+            rogue1 = (x, y, 1)
+            break
+        x += 1
+    g1_jacs = [rogue1, gen1.jac, (gen1 * 31337).jac, suite.g1_identity().jac]
+    pts1 = dc.g1_to_dev(g1_jacs)
+    n1 = len(g1_jacs)
+    endo1 = jnp.asarray(dc.endo_bits(False, n1))
+    _, chain1 = dc.scalar_mul2(
+        dc.G1_OPS, pts1, jnp.zeros((n1, dc.ENDO_NBITS), jnp.int32), endo1
+    )
+    ok1 = np.asarray(dc.endo_subgroup_eq(dc.G1_OPS, pts1, chain1))
+    want1 = [oc.g1_in_subgroup(j) for j in g1_jacs]
+    assert list(map(bool, ok1)) == want1 == [False, True, True, True]
