@@ -7,12 +7,18 @@ repo docs).  Must run before any ``import jax`` anywhere in the suite.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# HBBFT_TPU_TESTS_ON_TPU=1 opts OUT of the CPU forcing so the device
+# test battery can run against the real chip when the relay is up
+# (multi-device sharding tests then skip on the 1-chip platform).
+_ON_TPU = bool(os.environ.get("HBBFT_TPU_TESTS_ON_TPU"))
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 # The axon plugin's sitecustomize registers its backend and pins
@@ -21,7 +27,7 @@ os.environ.setdefault("JAX_ENABLE_X64", "true")
 # backend cache (no arrays exist yet, so this is safe).
 import jax  # noqa: E402
 
-if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+if not _ON_TPU and (jax.default_backend() != "cpu" or len(jax.devices()) < 8):
     jax.config.update("jax_platforms", "cpu")
     from jax.extend.backend import clear_backends  # noqa: E402
 
