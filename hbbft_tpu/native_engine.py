@@ -513,9 +513,12 @@ class NativeQhbNet:
         self.nodes: Dict[int, _NativeNode] = {}
         self._suite = suite
         # Committed payload bytes are identical across all N nodes; decode
-        # once per distinct payload instead of once per node.  Decoded
-        # contributions are treated as immutable by every consumer (QHB
-        # absorb, DHB batch processing), so sharing is safe.
+        # once per distinct payload instead of once per node.  Consumers
+        # may attach ONLY pure-function memos keyed by all of their
+        # inputs to the shared objects (e.g. SignedVote/_KeyGenMsg
+        # `_sp_bytes`/`_sig_ok`, Ciphertext `_verify_ok`); node-local or
+        # impure state on a shared decoded object would silently couple
+        # nodes and is forbidden.
         self._decode_cache: Dict[bytes, Any] = {}
         self._slot_cache: Dict[tuple, Any] = {}  # (era, epoch, proposer, len)
         for i in range(n):

@@ -130,6 +130,36 @@ class JoinPlan:
         return dict(self.validators)
 
 
+def _memo_signed_payload(obj: Any, build) -> bytes:
+    """Payload bytes are a pure function of the (frozen) object; committed
+    messages are decode-cache-shared across all N nodes, so caching on the
+    object turns N identical serializations into one."""
+    cached = obj.__dict__.get("_sp_bytes")
+    if cached is None:
+        cached = build()
+        object.__setattr__(obj, "_sp_bytes", cached)
+    return cached
+
+
+def _memo_sig_verdict(obj: Any, pk: Any, check) -> bool:
+    """Signature verdicts are pure functions of (pk, payload, signature);
+    key the per-object memo by the pk's canonical bytes so nodes with
+    diverging validator maps can never share a wrong verdict."""
+    try:
+        key = pk.to_bytes()
+    except Exception:
+        return bool(check())
+    memo = obj.__dict__.get("_sig_ok")
+    if memo is None:
+        memo = {}
+        object.__setattr__(obj, "_sig_ok", memo)
+    ok = memo.get(key)
+    if ok is None:
+        ok = bool(check())
+        memo[key] = ok
+    return ok
+
+
 @dataclass(frozen=True)
 class SignedVote:
     voter: Any
@@ -139,8 +169,11 @@ class SignedVote:
     signature: Any
 
     def signed_payload(self) -> bytes:
-        return canonical_bytes(
-            b"dhb-vote", str(self.voter), self.era, self.num, self.change.digest()
+        return _memo_signed_payload(
+            self,
+            lambda: canonical_bytes(
+                b"dhb-vote", str(self.voter), self.era, self.num, self.change.digest()
+            ),
         )
 
 
@@ -154,8 +187,11 @@ class SignedKeyGenMsg:
     signature: Any
 
     def signed_payload(self) -> bytes:
-        return canonical_bytes(
-            b"dhb-kg", str(self.sender), self.era, _kg_payload_bytes(self.payload)
+        return _memo_signed_payload(
+            self,
+            lambda: canonical_bytes(
+                b"dhb-kg", str(self.sender), self.era, _kg_payload_bytes(self.payload)
+            ),
         )
 
 
@@ -259,6 +295,11 @@ class _KeyGenState:
         self.key_gen = key_gen
         self.threshold = threshold
         self.parts_handled: Dict[Any, bool] = {}
+        # change.validator_map() builds a fresh dict per call; the kg
+        # signature path asks once per committed Part/Ack (N^2 per churn).
+        self.val_map: Dict[Any, Any] = (
+            change.validator_map() if change.kind == "node_change" else {}
+        )
 
     @property
     def ready(self) -> bool:
@@ -498,7 +539,9 @@ class DynamicHoneyBadger(ConsensusProtocol):
             return step.fault(proposer, FAULT_BAD_VOTE_SIG)
         try:
             pk = self._netinfo.public_key(vote.voter)
-            ok = pk.verify(vote.signed_payload(), vote.signature)
+            ok = _memo_sig_verdict(
+                vote, pk, lambda: pk.verify(vote.signed_payload(), vote.signature)
+            )
         except Exception:
             ok = False
         if not ok:
@@ -541,9 +584,11 @@ class DynamicHoneyBadger(ConsensusProtocol):
         sender = kg.sender
         # Signature check: the sender must be a CURRENT-era validator
         # (only they deal/ack) or a NEW-set member for acks.
-        pk = self._netinfo.public_key_map.get(sender) or state.change.validator_map().get(sender)
+        pk = self._netinfo.public_key_map.get(sender) or state.val_map.get(sender)
         try:
-            ok = pk is not None and pk.verify(kg.signed_payload(), kg.signature)
+            ok = pk is not None and _memo_sig_verdict(
+                kg, pk, lambda: pk.verify(kg.signed_payload(), kg.signature)
+            )
         except Exception:
             ok = False
         if not ok:
