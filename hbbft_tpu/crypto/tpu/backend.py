@@ -46,6 +46,7 @@ from hbbft_tpu.crypto.backend import (
     CIPHERTEXT,
     DEC_SHARE,
     SIG_SHARE,
+    BatchedBackend,
     CryptoBackend,
     EagerBackend,
     VerifyRequest,
@@ -316,3 +317,60 @@ class TpuBackend(CryptoBackend):
         mid = len(idxs) // 2
         self._verify_range(all_reqs, idxs[:mid], out)
         self._verify_range(all_reqs, idxs[mid:], out)
+
+
+class HybridBackend(CryptoBackend):
+    """Route each flush to the cheaper plane, fail over off-device.
+
+    * Flushes with at least ``min_device_batch`` requests go to
+      :class:`TpuBackend`; smaller ones go to the host
+      :class:`~hbbft_tpu.crypto.backend.BatchedBackend` — small flushes
+      are latency-dominated either way, and keeping them host-side
+      avoids paying a fresh ~10-min XLA compile for every rare small
+      shape bucket (measured, BASELINE.md round-3 battery).
+    * If no accelerator platform is reachable at construction (the axon
+      relay was down for rounds 1-2 straight), every flush rides the
+      host path — protocols keep running, just without the device plane.
+
+    Verdict-identical to both constituents by construction: every
+    backend implements the same RLC/bisection semantics (pinned by
+    tests/test_tpu_crypto.py + the backend-equivalence drive).
+    """
+
+    # Pass as ``device=`` to force host-only mode regardless of platform
+    # (None means auto-detect, so it cannot express "no device").
+    NO_DEVICE: Any = object()
+
+    def __init__(
+        self,
+        suite: BLSSuite | None = None,
+        min_device_batch: int = 64,
+        device: CryptoBackend | None = None,
+        host: CryptoBackend | None = None,
+    ) -> None:
+        self.suite = suite or BLSSuite()
+        self.min_device_batch = min_device_batch
+        self.host = host or BatchedBackend(self.suite)
+        if device is HybridBackend.NO_DEVICE:
+            self.device: CryptoBackend | None = None
+        elif device is not None:
+            self.device = device
+        else:
+            try:
+                ok = jax.default_backend() not in ("", "cpu")
+            except Exception:
+                ok = False
+            self.device = TpuBackend(self.suite) if ok else None
+
+    def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
+        reqs = list(reqs)
+        if self.device is not None and len(reqs) >= self.min_device_batch:
+            try:
+                return self.device.verify_batch(reqs)
+            except Exception:
+                # Device died mid-run (the relay drops, historically) —
+                # serve this and every later flush from the host plane.
+                # Verdict-identical by construction, so the failover is
+                # invisible to the protocol.
+                self.device = None
+        return self.host.verify_batch(reqs)

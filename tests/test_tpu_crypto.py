@@ -381,3 +381,56 @@ def test_device_endo_subgroup_matches_oracle():
     ok1 = np.asarray(dc.endo_subgroup_eq(dc.G1_OPS, pts1, chain1))
     want1 = [oc.g1_in_subgroup(j) for j in g1_jacs]
     assert list(map(bool, ok1)) == want1 == [False, True, True, True]
+
+
+def test_hybrid_backend_routing():
+    """HybridBackend: device for big flushes, host for small, host-only
+    when no accelerator is present (routing logic is platform-free)."""
+    from hbbft_tpu.crypto.tpu.backend import HybridBackend
+
+    calls = []
+
+    class Stub:
+        def __init__(self, name):
+            self.name = name
+
+        def verify_batch(self, reqs):
+            calls.append((self.name, len(reqs)))
+            return [True] * len(reqs)
+
+    suite = BLSSuite()
+    hy = HybridBackend(
+        suite, min_device_batch=4, device=Stub("dev"), host=Stub("host")
+    )
+    small = [object()] * 3
+    big = [object()] * 9
+    assert hy.verify_batch(small) == [True] * 3
+    assert hy.verify_batch(big) == [True] * 9
+    assert calls == [("host", 3), ("dev", 9)]
+
+    # Forced host-only (the relay-down operating mode) — explicit
+    # sentinel, so this asserts on every platform.
+    calls.clear()
+    hy2 = HybridBackend(
+        suite, min_device_batch=4, device=HybridBackend.NO_DEVICE,
+        host=Stub("host"),
+    )
+    assert hy2.device is None
+    assert hy2.verify_batch(big) == [True] * 9
+    assert calls == [("host", 9)]
+
+    # Mid-run device failure fails over to the host and disables the
+    # device for later flushes.
+    calls.clear()
+
+    class Dying:
+        def verify_batch(self, reqs):
+            raise RuntimeError("relay dropped")
+
+    hy3 = HybridBackend(
+        suite, min_device_batch=4, device=Dying(), host=Stub("host")
+    )
+    assert hy3.verify_batch(big) == [True] * 9
+    assert hy3.device is None
+    assert hy3.verify_batch(big) == [True] * 9
+    assert calls == [("host", 9), ("host", 9)]
