@@ -210,6 +210,11 @@ class TpuBackend(CryptoBackend):
         return g2_entries, g1_entries, rhs
 
     def _aggregate_ok(self, reqs: Sequence[VerifyRequest]) -> bool:
+        return bool(self._aggregate_dev(reqs))
+
+    def _aggregate_dev(self, reqs: Sequence[VerifyRequest]):
+        """Dispatch one flush kernel; returns the device scalar WITHOUT
+        forcing a host sync, so independent chunks pipeline on device."""
         coeffs = _batch_coefficients(self.suite, reqs)
         g2e, g1e, rhs = self._build_legs(reqs, coeffs)
         n1 = _bucket(max(len(g1e), 1))
@@ -274,7 +279,7 @@ class TpuBackend(CryptoBackend):
             g1_pts, g1_bits, g1_chk, seg,
             g2_pts, g2_bits, g2_chk, rhs_pts, gen_pt
         )
-        return bool(ok)
+        return ok
 
     # -- public API ----------------------------------------------------
 
@@ -297,26 +302,34 @@ class TpuBackend(CryptoBackend):
             for i, r in enumerate(reqs)
             if request_well_formed(self.suite, r, subgroup=False)
         ]
-        for s in range(0, len(idxs), self.CHUNK):
-            self._verify_range(reqs, idxs[s : s + self.CHUNK], out)
+        chunks = [idxs[s : s + self.CHUNK] for s in range(0, len(idxs), self.CHUNK)]
+        # Dispatch every chunk's kernel before syncing on any verdict:
+        # jax dispatch is async, so the device pipelines the chunks and
+        # the host pays one round-trip total instead of one per chunk.
+        aggs = [self._aggregate_dev([reqs[i] for i in c]) for c in chunks]
+        for c, agg in zip(chunks, aggs):
+            if bool(agg):
+                for i in c:
+                    out[i] = True
+            else:
+                self._bisect(reqs, c, out)
         return out
 
-    def _verify_range(
+    def _bisect(
         self, all_reqs: List[VerifyRequest], idxs: List[int], out: List[bool]
     ) -> None:
-        if not idxs:
-            return
-        sub = [all_reqs[i] for i in idxs]
-        if self._aggregate_ok(sub):
-            for i in idxs:
-                out[i] = True
-            return
+        """Bisection fallback — the caller knows idxs' aggregate FAILED,
+        so split immediately and aggregate only the halves."""
         if len(idxs) == 1:
-            out[idxs[0]] = self._eager.verify_batch(sub)[0]
+            out[idxs[0]] = self._eager.verify_batch([all_reqs[idxs[0]]])[0]
             return
         mid = len(idxs) // 2
-        self._verify_range(all_reqs, idxs[:mid], out)
-        self._verify_range(all_reqs, idxs[mid:], out)
+        for half in (idxs[:mid], idxs[mid:]):
+            if self._aggregate_ok([all_reqs[i] for i in half]):
+                for i in half:
+                    out[i] = True
+            else:
+                self._bisect(all_reqs, half, out)
 
 
 class HybridBackend(CryptoBackend):

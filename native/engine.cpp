@@ -223,6 +223,12 @@ inline U256 u256_from_be(const uint8_t* in, size_t len) {
 // ===========================================================================
 
 using Bytes = std::string;  // byte strings
+// Big payloads (RBC values, HB plaintexts, serde ciphertexts) are
+// shared, never copied: at an era change a single DKG-epoch payload
+// is several hundred KB and flows through decode-cache -> Bcast ->
+// Subset -> ThresholdDecrypt -> batch; per-stage copies at N=64 were
+// gigabytes of memcpy (round-3 era profile).
+using BytesP = std::shared_ptr<const Bytes>;
 
 inline void canon_part(hbn::Sha3& h, const uint8_t* data, size_t len) {
   uint8_t len8[8];
@@ -634,7 +640,7 @@ struct Td {
   bool has_ct = false;
   ScalarCiphertext ct;
   U256 ct_h = U256_ZERO;  // hash_to_g2 of ct hash input
-  Bytes ct_payload;       // serde(Ciphertext) bytes (external-crypto mode)
+  BytesP ct_payload;      // serde(Ciphertext) bytes (external-crypto mode)
   bool ct_valid = false;
   bool ciphertext_invalid = false;
   std::vector<std::pair<int, U256>> buffered;  // arrival order (scalar)
@@ -644,8 +650,7 @@ struct Td {
   NodeSet verified_set;
   NodeSet seen;
   bool terminated = false;
-  Bytes plaintext;
-  bool has_plaintext = false;
+  BytesP plaintext;
 };
 
 // ===========================================================================
@@ -667,8 +672,7 @@ struct Bcast {
   bool ready_sent = false;
   bool had_input = false;
   bool terminated = false;
-  Bytes value;
-  bool has_value = false;
+  BytesP value;
 };
 
 // ===========================================================================
@@ -693,6 +697,7 @@ struct Ba {
   NodeSet terms[2];
   NodeSet term_senders;
   std::vector<std::pair<int, EMsg>> future;
+  std::map<int, int> future_count;  // per-sender future-buffer occupancy
   int decision = -1;
   bool terminated = false;
 };
@@ -704,8 +709,7 @@ struct Ba {
 struct Proposal {
   std::unique_ptr<Bcast> bc;
   std::unique_ptr<Ba> ba;
-  Bytes value;
-  bool has_value = false;
+  BytesP value;
   int decision = -1;  // -1 undecided
   bool emitted = false;
 };
@@ -715,7 +719,7 @@ struct Proposal {
 struct SubsetOutItem {
   bool done;
   int proposer;
-  Bytes value;
+  BytesP value;
 };
 
 struct EpochState {
@@ -728,18 +732,18 @@ struct EpochState {
   bool subset_terminated = false;
   std::map<int, std::shared_ptr<Td>> decrypts;
   std::vector<int> accepted_order;  // proposer ids in acceptance order
-  std::map<int, Bytes> plaintexts;  // proposer -> decoded-ok plaintext marker
+  std::map<int, BytesP> plaintexts;  // proposer -> decoded-ok plaintext marker
   NodeSet decrypted;
   NodeSet faulty_proposers;
   bool proposed = false;
   bool batch_emitted = false;
   std::vector<SubsetOutItem> pending_outputs;
-  std::vector<std::pair<int, Bytes>> pending_payloads;  // all_at_end buffer
+  std::vector<std::pair<int, BytesP>> pending_payloads;  // all_at_end buffer
 };
 
 struct BatchData {
   int era, epoch;
-  std::vector<std::pair<int, Bytes>> contributions;  // str-sorted
+  std::vector<std::pair<int, BytesP>> contributions;  // str-sorted
 };
 
 const int FUTURE_BUFFER_FACTOR = 64;
@@ -856,7 +860,7 @@ struct Engine {
   BatchEventCb batch_cb = nullptr;
   ContribCb contrib_cb = nullptr;
   // current batch exposed to Python during batch_cb
-  std::vector<std::pair<int, Bytes>> cur_batch;  // str-sorted (proposer, payload)
+  std::vector<std::pair<int, BytesP>> cur_batch;  // str-sorted (proposer, payload)
   int depth = 0;  // >0 while inside a processing unit (nested entry points)
   // -- external-crypto mode ------------------------------------------------
   bool ext = false;
@@ -878,7 +882,7 @@ struct Engine {
   // network — any >= k validated shards of that root reconstruct the
   // same bytes (shards that validate against the root ARE the committed
   // codeword, collisions aside).  Bounded FIFO.
-  std::map<Root, Bytes> decoded_roots;
+  std::map<Root, BytesP> decoded_roots;
   std::deque<Root> decoded_order;
   // Per-message-type delivery profiling (rdtsc cycles + counts).
   uint64_t prof_cycles[16] = {};
@@ -1524,6 +1528,7 @@ struct Ctx {
     // Replay buffered future-round messages.
     std::vector<std::pair<int, EMsg>> future;
     future.swap(ba.future);
+    ba.future_count.clear();
     for (auto& sm : future) ba_handle_message(st, proposer, ba, sm.first, sm.second);
   }
 
@@ -1583,10 +1588,14 @@ struct Ctx {
     if (m.round < ba.round) return;  // stale: drop
     if (m.round > ba.round) {
       if (m.round - ba.round <= MAX_FUTURE_ROUNDS) {
-        int cnt = 0;
-        for (auto& sm : ba.future)
-          if (sm.first == sender) ++cnt;
-        if (cnt < 4 * MAX_FUTURE_ROUNDS) ba.future.push_back({sender, m});
+        // Per-sender counter instead of scanning the buffer: the linear
+        // scan was O(buffered) per future message (quadratic per round
+        // at churn when rounds lag across the network).
+        int& cnt = ba.future_count[sender];
+        if (cnt < 4 * MAX_FUTURE_ROUNDS) {
+          ++cnt;
+          ba.future.push_back({sender, m});
+        }
       }
       return;
     }
@@ -1626,7 +1635,7 @@ struct Ctx {
   // outputs after the complete subset-level call.  Draining inline
   // would reorder verify-pool submissions (decrypt vs coin shares).
 
-  void subset_input(EpochState& st, const Bytes& payload) {
+  void subset_input(EpochState& st, const BytesP& payload) {
     if (st.subset_terminated) return;
     bc_input(st, node.id, *st.proposals[node.id].bc, payload);
   }
@@ -1653,10 +1662,9 @@ struct Ctx {
   }
 
   // Broadcast delivered a value for this proposer (subset._on_bc_step).
-  void subset_on_bc_value(EpochState& st, int proposer, const Bytes& value) {
+  void subset_on_bc_value(EpochState& st, int proposer, const BytesP& value) {
     Proposal& prop = st.proposals[proposer];
-    if (!prop.has_value) {
-      prop.has_value = true;
+    if (!prop.value) {
       prop.value = value;
       ba_input(st, proposer, *prop.ba, true);
     }
@@ -1690,7 +1698,7 @@ struct Ctx {
   void subset_progress(EpochState& st, int proposer) {
     if (st.subset_terminated) return;
     Proposal& prop = st.proposals[proposer];
-    if (prop.decision == 1 && prop.has_value && !prop.emitted) {
+    if (prop.decision == 1 && prop.value && !prop.emitted) {
       prop.emitted = true;
       st.pending_outputs.push_back({false, proposer, prop.value});
     }
@@ -1703,7 +1711,7 @@ struct Ctx {
     if (all_decided && all_done && !st.done_emitted) {
       st.done_emitted = true;
       st.subset_terminated = true;
-      st.pending_outputs.push_back({true, 0, Bytes()});
+      st.pending_outputs.push_back({true, 0, nullptr});
     }
   }
 
@@ -1723,11 +1731,11 @@ struct Ctx {
       ops.send(dest, m);
   }
 
-  void bc_input(EpochState& st, int proposer, Bcast& bc, const Bytes& value) {
+  void bc_input(EpochState& st, int proposer, Bcast& bc, const BytesP& value) {
     if (node.id != bc.proposer || bc.had_input) return;
     bc.had_input = true;
     int k = bc.data_shards;
-    std::vector<Bytes> shards = rbc_pack(value, k, rs_align(n()));
+    std::vector<Bytes> shards = rbc_pack(*value, k, rs_align(n()));
     // RS parity over the VALIDATOR count (shards are per validator index)
     size_t size = shards[0].size();
     std::vector<uint8_t> data(k * size);
@@ -1982,7 +1990,6 @@ struct Ctx {
       auto hit = e.decoded_roots.find(root);
       if (hit != e.decoded_roots.end()) {
         bc.value = hit->second;
-        bc.has_value = true;
         bc.terminated = true;
         subset_on_bc_value(st, proposer, bc.value);
         return;
@@ -2051,16 +2058,16 @@ struct Ctx {
         ops.fault(bc.proposer, F_BC_BAD_ENC);
         return;
       }
-      e.decoded_roots.emplace(root, value);
+      BytesP vp = std::make_shared<const Bytes>(std::move(value));
+      e.decoded_roots.emplace(root, vp);
       e.decoded_order.push_back(root);
       if (e.decoded_order.size() > DECODED_ROOTS_MAX) {
         e.decoded_roots.erase(e.decoded_order.front());
         e.decoded_order.pop_front();
       }
-      bc.value = value;
-      bc.has_value = true;
+      bc.value = vp;
       bc.terminated = true;
-      subset_on_bc_value(st, proposer, value);
+      subset_on_bc_value(st, proposer, vp);
       return;
     }
   }
@@ -2098,7 +2105,7 @@ struct Ctx {
   // External mode: the payload already passed the Python-side serde
   // decode gate (ct_parse_cb); validity is a deferred VK_CT request.
   void td_handle_input_ext(EpochState& st, int proposer,
-                           std::shared_ptr<Td> td, const Bytes& payload) {
+                           std::shared_ptr<Td> td, const BytesP& payload) {
     if (td->has_ct || td->terminated) return;
     td->has_ct = true;
     td->ct_payload = payload;
@@ -2109,7 +2116,7 @@ struct Ctx {
     p.need_verdict = true;
     p.req.kind = VK_CT;
     p.req.era = era;
-    p.req.ct = &td->ct_payload;  // Td kept alive by the continuation
+    p.req.ct = td->ct_payload.get();  // Td kept alive by the continuation
     p.run = [eng, nd, era, epoch, proposer, td](bool ok) {
       Ctx c(*eng, *nd);
       c.td_ct_checked_cb(era, epoch, proposer, td, ok);
@@ -2122,7 +2129,7 @@ struct Ctx {
                         std::shared_ptr<Td> td, bool ok) {
     bool live = node.era == era && node.hb && node.hb->epoch == epoch;
     if (!live) e.suppress_emit++;
-    std::vector<Bytes> plain_out;
+    std::vector<BytesP> plain_out;
     // inner: ThresholdDecrypt._on_ciphertext_checked
     if (!td->terminated) {
       if (!ok) {
@@ -2139,8 +2146,9 @@ struct Ctx {
           td->seen.add(node.id);
           if (e.ext) {
             auto share_b = std::make_shared<Bytes>();
-            e.sign_cb(node.id, era, 1, (const uint8_t*)td->ct_payload.data(),
-                      td->ct_payload.size(), share_b.get());
+            e.sign_cb(node.id, era, 1,
+                      (const uint8_t*)td->ct_payload->data(),
+                      td->ct_payload->size(), share_b.get());
             m.share_b = share_b;
             td->verified_b.push_back({node.id, *share_b});
           } else {
@@ -2198,7 +2206,7 @@ struct Ctx {
     p.req.kind = VK_DEC;
     p.req.era = era;
     p.req.sender = sender;
-    p.req.ct = &td->ct_payload;
+    p.req.ct = td->ct_payload.get();
     p.req.share = share_b;
     p.run = [eng, nd, era, epoch, proposer, td, sender, share_b](bool ok) {
       Ctx c(*eng, *nd);
@@ -2213,7 +2221,7 @@ struct Ctx {
                       std::shared_ptr<const Bytes> share_b, bool ok) {
     bool live = node.era == era && node.hb && node.hb->epoch == epoch;
     if (!live) e.suppress_emit++;
-    std::vector<Bytes> plain_out;
+    std::vector<BytesP> plain_out;
     if (!td->terminated) {  // Python: terminated check BEFORE the ok check
       if (!ok) {
         ops.fault(sender, F_TD_INVALID);
@@ -2268,7 +2276,7 @@ struct Ctx {
     }
   }
 
-  void td_try_output(Td& td, std::vector<Bytes>& plain_out) {
+  void td_try_output(Td& td, std::vector<BytesP>& plain_out) {
     int threshold = f();
     size_t have = e.ext ? td.verified_b.size() : td.verified.size();
     if (td.terminated || (int)have < threshold + 1) return;
@@ -2283,13 +2291,13 @@ struct Ctx {
       for (auto& kv : by_index) e.cur_comb.push_back({kv.first, kv.second});
       Bytes plain;
       e.combine_cb(node.id, node.era, 1,
-                   (const uint8_t*)td.ct_payload.data(), td.ct_payload.size(),
-                   (int32_t)e.cur_comb.size(), &plain);
+                   (const uint8_t*)td.ct_payload->data(),
+                   td.ct_payload->size(), (int32_t)e.cur_comb.size(), &plain);
       e.cur_comb.clear();
-      td.plaintext = plain;
-      td.has_plaintext = true;
+      BytesP pp = std::make_shared<const Bytes>(std::move(plain));
+      td.plaintext = pp;
       td.terminated = true;
-      plain_out.push_back(std::move(plain));
+      plain_out.push_back(std::move(pp));
       return;
     }
     std::vector<std::pair<int, U256>> by_index;
@@ -2339,10 +2347,10 @@ struct Ctx {
       std::memcpy(p + i, &a, 8);
     }
     for (; i < sz; ++i) p[i] ^= m[i];
-    td.plaintext = plain;
-    td.has_plaintext = true;
+    BytesP pp = std::make_shared<const Bytes>(std::move(plain));
+    td.plaintext = pp;
     td.terminated = true;
-    plain_out.push_back(std::move(plain));
+    plain_out.push_back(std::move(pp));
   }
 
   // ---- HoneyBadger epoch state / advance ----------------------------------
@@ -2351,23 +2359,23 @@ struct Ctx {
   // then plaintext outputs -> _accept_plaintext.  Runs only when the
   // (era, epoch) is live (the _guard_epoch wrap).
   void hb_on_decrypt_boundary(int proposer, std::shared_ptr<Td> td,
-                              std::vector<Bytes>& plain_out) {
+                              std::vector<BytesP>& plain_out) {
     EpochState& st = *node.hb->state;
     if (td->ciphertext_invalid && !st.faulty_proposers.has(proposer)) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CT);
       hb_try_batch(st);
     }
-    for (Bytes& p : plain_out) hb_accept_plaintext(st, proposer, p);
+    for (BytesP& p : plain_out) hb_accept_plaintext(st, proposer, p);
     plain_out.clear();
   }
 
-  void hb_accept_plaintext(EpochState& st, int proposer, const Bytes& data) {
+  void hb_accept_plaintext(EpochState& st, int proposer, const BytesP& data) {
     if (st.decrypted.has(proposer) || st.faulty_proposers.has(proposer)) return;
-    int ok = e.contrib_cb
-                 ? e.contrib_cb(node.id, node.era, st.epoch, proposer,
-                                (const uint8_t*)data.data(), data.size())
-                 : 1;
+    int ok = 1;
+    if (e.contrib_cb)
+      ok = e.contrib_cb(node.id, node.era, st.epoch, proposer,
+                        (const uint8_t*)data->data(), data->size());
     if (!ok) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CONTRIB);
@@ -2402,7 +2410,7 @@ struct Ctx {
         st.subset_done = true;
         // all_at_end: start every deferred decrypt now, in acceptance
         // order (honey_badger._on_subset_output "done" branch).
-        std::vector<std::pair<int, Bytes>> pend;
+        std::vector<std::pair<int, BytesP>> pend;
         pend.swap(st.pending_payloads);
         for (auto& pv : pend) hb_start_decrypt(st, pv.first, pv.second);
         hb_try_batch(st);
@@ -2418,7 +2426,7 @@ struct Ctx {
     st.pending_outputs.clear();
   }
 
-  void hb_start_decrypt(EpochState& st, int proposer, const Bytes& payload) {
+  void hb_start_decrypt(EpochState& st, int proposer, const BytesP& payload) {
     if (!st.encrypted) {
       hb_accept_plaintext(st, proposer, payload);
       return;
@@ -2427,8 +2435,8 @@ struct Ctx {
       // serde decode verdict comes from Python (identical to
       // honey_badger._start_decrypt's try_loads gate).
       int ok = e.ct_parse_cb
-                   ? e.ct_parse_cb(node.id, (const uint8_t*)payload.data(),
-                                   payload.size())
+                   ? e.ct_parse_cb(node.id, (const uint8_t*)payload->data(),
+                                   payload->size())
                    : 0;
       if (!ok) {
         st.faulty_proposers.add(proposer);
@@ -2441,8 +2449,8 @@ struct Ctx {
       return;
     }
     ScalarCiphertext ct;
-    if (!decode_scalar_ciphertext((const uint8_t*)payload.data(),
-                                  payload.size(), ct)) {
+    if (!decode_scalar_ciphertext((const uint8_t*)payload->data(),
+                                  payload->size(), ct)) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CT);
       hb_try_batch(st);
@@ -2530,7 +2538,7 @@ struct Ctx {
       auto td = hb_get_decrypt(st, m.proposer);
       td_handle_message(st, m.proposer, td, sender, m);
       // _on_decrypt_step boundary: invalid-ct check after every td call.
-      std::vector<Bytes> none;
+      std::vector<BytesP> none;
       hb_on_decrypt_boundary(m.proposer, td, none);
       return;
     }
@@ -2567,7 +2575,7 @@ struct Ctx {
     EpochState& st = *node.hb->state;
     if (st.proposed) return;
     st.proposed = true;
-    subset_input(st, payload);
+    subset_input(st, std::make_shared<const Bytes>(payload));
     hb_drain_subset_outputs(st);
     hb_advance();
   }
@@ -2722,6 +2730,64 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
 
 extern "C" {
 
+// --- scalar-suite KEM fast path (stateless; no engine handle) --------------
+//
+// Mirrors keys.py PublicKey.encrypt / SecretKey.decrypt for the scalar
+// suite byte-for-byte (canonical_bytes framing, kdf_stream, h2g2) so the
+// Python layer can route the N^3 DKG ack/row KEM operations here without
+// changing any protocol output.  Randomness for encrypt is drawn by the
+// CALLER (Python rng) to keep the rng consumption stream identical to the
+// pure-Python stack — the equivalence tests depend on it.
+
+// Decrypt: validate the ciphertext (w == u * h2g2(ct-hash-input), the
+// scalar-suite pairing check), then unmask v with kdf(u * x).  u/w/x are
+// 32-byte big-endian scalars < r; out must hold v_len bytes.  Returns 1
+// and fills out on a valid ciphertext, 0 otherwise (out untouched).
+int32_t hbe_kem_decrypt(const uint8_t* u_be, const uint8_t* v, uint64_t v_len,
+                        const uint8_t* w_be, const uint8_t* x_be,
+                        uint8_t* out) {
+  ScalarCiphertext ct;
+  ct.u = u256_from_be(u_be, 32);
+  ct.w = u256_from_be(w_be, 32);
+  ct.v.assign((const char*)v, v_len);
+  U256 h = ct_hash_scalar(ct);
+  if (!(mulmod(ct.u, h) == ct.w)) return 0;
+  U256 shared = mulmod(ct.u, u256_from_be(x_be, 32));
+  uint8_t sh_be[32];
+  u256_to_be32(shared, sh_be);
+  Bytes seed;
+  canon_append(seed, "kem");
+  canon_append(seed, Bytes((const char*)sh_be, 32));
+  Bytes mask = kdf_stream(seed, v_len);
+  for (uint64_t i = 0; i < v_len; ++i)
+    out[i] = v[i] ^ (uint8_t)mask[i];
+  return 1;
+}
+
+// Encrypt msg to pk with caller-provided randomness r (32B BE, in [1, r)).
+// out_u/out_w: 32 bytes each; out_v: msg_len bytes.
+void hbe_kem_encrypt(const uint8_t* pk_be, const uint8_t* msg,
+                     uint64_t msg_len, const uint8_t* r_be, uint8_t* out_u,
+                     uint8_t* out_v, uint8_t* out_w) {
+  U256 r = u256_from_be(r_be, 32);
+  U256 pk = u256_from_be(pk_be, 32);
+  u256_to_be32(r, out_u);  // u = g1_generator * r = r in the scalar group
+  U256 shared = mulmod(pk, r);
+  uint8_t sh_be[32];
+  u256_to_be32(shared, sh_be);
+  Bytes seed;
+  canon_append(seed, "kem");
+  canon_append(seed, Bytes((const char*)sh_be, 32));
+  Bytes mask = kdf_stream(seed, msg_len);
+  for (uint64_t i = 0; i < msg_len; ++i)
+    out_v[i] = msg[i] ^ (uint8_t)mask[i];
+  ScalarCiphertext ct;
+  ct.u = r;
+  ct.v.assign((const char*)out_v, msg_len);
+  U256 h = ct_hash_scalar(ct);
+  u256_to_be32(mulmod(h, r), out_w);
+}
+
 void* hbe_create(int32_t n, int32_t f) {
   // MAX_NODES = this build's NodeSet width (the loader picks a wide
   // enough build); 65535 = the GF(2^16) codec's point budget.
@@ -2859,10 +2925,10 @@ int32_t hbe_batch_proposer(void* h, int32_t i) {
   return ((Engine*)h)->cur_batch[i].first;
 }
 uint64_t hbe_batch_payload_len(void* h, int32_t i) {
-  return ((Engine*)h)->cur_batch[i].second.size();
+  return ((Engine*)h)->cur_batch[i].second->size();
 }
 void hbe_batch_payload(void* h, int32_t i, uint8_t* out) {
-  const Bytes& b = ((Engine*)h)->cur_batch[i].second;
+  const Bytes& b = *((Engine*)h)->cur_batch[i].second;
   std::memcpy(out, b.data(), b.size());
 }
 
