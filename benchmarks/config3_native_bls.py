@@ -10,7 +10,7 @@ the delivery queue runs dry (``flush_every=0`` — maximal amortization).
 Prints one JSON line per epoch batch committed plus a summary line.
 
 Env knobs: BENCH_NODES (16), BENCH_TXNS (256), BENCH_BATCH (256),
-BENCH_BACKEND (batched|eager|tpu), BENCH_FLUSH (0).
+BENCH_BACKEND (batched|eager|tpu|hybrid), BENCH_FLUSH (0).
 """
 
 from __future__ import annotations
@@ -36,6 +36,20 @@ def make_backend(name: str, suite):
         from hbbft_tpu.crypto.tpu import TpuBackend
 
         return TpuBackend(suite)
+    if name == "hybrid":
+        # The deployment-shaped choice: flushes below min_device_batch
+        # ride the host (this config's mean flush is ~4 requests — a
+        # device round-trip per tiny flush, plus a fresh ~10-min compile
+        # per small shape bucket, would swamp the epoch); the big deduped
+        # flushes ride the chip.  Failover scope: HybridBackend handles a
+        # device that is absent at CONSTRUCTION or dies MID-RUN — but
+        # importing jax at all hangs when the axon relay is down
+        # (CLAUDE.md gotcha), so on a dead relay run this with
+        # JAX_PLATFORMS=cpu (the battery only selects hybrid after its
+        # TPU probe succeeds).
+        from hbbft_tpu.crypto.tpu import HybridBackend
+
+        return HybridBackend(suite, min_device_batch=64)
     return BatchedBackend(suite)
 
 
