@@ -22,6 +22,7 @@ Scheme (conventions as in the reference):
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, Mapping, Optional
 
@@ -330,6 +331,9 @@ class _ScalarKem:
             and isinstance(ct.v, bytes)
             and isinstance(ct.u.value, int)
             and isinstance(ct.w.value, int)
+            and ct.u.modulus == self._mod
+            and ct.w.modulus == self._mod
+            and ct.suite == self._suite
             and 0 <= ct.u.value < self._mod
             and 0 <= ct.w.value < self._mod
         )
@@ -389,7 +393,14 @@ def _scalar_kem(suite: Suite) -> Optional[_ScalarKem]:
 
         lib = native_engine.get_lib()
         kem = _ScalarKem(lib, suite) if lib is not None else None
-    except Exception:
+    except Exception as exc:
+        # Perf path only — the pure-Python KEM is always correct — but
+        # the miss is permanent for the process, so say it once.
+        warnings.warn(
+            f"native KEM unavailable, using the pure-Python path: {exc!r}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         kem = None
     _KEM_CACHE[suite.name] = kem
     return kem

@@ -35,6 +35,7 @@ Replaces the per-share CPU pairing checks of upstream
 
 from __future__ import annotations
 
+import warnings
 from functools import lru_cache
 from typing import Any, Dict, List, Sequence, Tuple
 
@@ -380,10 +381,17 @@ class HybridBackend(CryptoBackend):
         if self.device is not None and len(reqs) >= self.min_device_batch:
             try:
                 return self.device.verify_batch(reqs)
-            except Exception:
+            except Exception as exc:
                 # Device died mid-run (the relay drops, historically) —
                 # serve this and every later flush from the host plane.
                 # Verdict-identical by construction, so the failover is
-                # invisible to the protocol.
+                # invisible to the protocol; warn so a genuine device
+                # bug or OOM isn't silently masked by the degradation.
+                warnings.warn(
+                    "HybridBackend: device flush failed, failing over to "
+                    f"host for the rest of the run: {exc!r}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
                 self.device = None
         return self.host.verify_batch(reqs)

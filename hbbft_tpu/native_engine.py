@@ -190,10 +190,13 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     # scalar-suite KEM fast path (stateless; used by crypto/keys.py)
     lib.hbe_kem_decrypt.restype = ctypes.c_int32
     lib.hbe_kem_decrypt.argtypes = [u8p, u8p, ctypes.c_uint64, u8p, u8p, u8p]
+    lib.hbe_kem_encrypt.restype = None
     lib.hbe_kem_encrypt.argtypes = [
         u8p, u8p, ctypes.c_uint64, u8p, u8p, u8p, u8p,
     ]
+    lib.hbe_flush.restype = None
     lib.hbe_flush.argtypes = [ctypes.c_void_p]
+    lib.hbe_ret_bytes.restype = None
     lib.hbe_ret_bytes.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
     for name in ("hbe_vreq_kind", "hbe_vreq_era", "hbe_vreq_sender",
                  "hbe_comb_index"):
