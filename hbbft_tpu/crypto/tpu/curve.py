@@ -350,6 +350,212 @@ def scalars_to_bits_lsb(scalars, nbits: int) -> jnp.ndarray:
     return jnp.asarray(_scalars_to_bits_np(scalars, nbits))
 
 
+# ---------------------------------------------------------------------------
+# Static-endo flush scans (round 4) — the per-row cost cut
+#
+# The flush kernel's per-row work is the scalar-mul scan.  Two structural
+# facts the shared 128-step scan (scalar_mul2) never exploited:
+#
+#   * the endomorphism-check scalars are FIXED — x^2 (hamming weight 17)
+#     on G1, |x| (hamming weight 6) on G2 — so the check chain needs an
+#     ADD at only those static positions, not a computed-and-discarded
+#     conditional add at every one of 128 steps;
+#   * on G2 the verified psi(Q) = [x]Q endomorphism gives a second base:
+#     a 128-bit RLC coefficient c splits as c = q·|x| + s (q ≤ 65 bits,
+#     s < 64), and [c]Q = [s]Q + [q]([|x|]Q) = [s]Q + [q](-psi(Q)) — a
+#     65-step two-scalar scan instead of 128 steps.  Using psi(Q) as a
+#     base is sound exactly when psi(Q) == [x]Q, which is the subgroup
+#     check VERIFIED IN THE SAME KERNEL: if it fails, the aggregate
+#     verdict is already False and the RLC value is irrelevant; if it
+#     holds, Q ∈ G2 (Bowe 2019/814 / Scott 2021/1130, bls.curve notes)
+#     and the decomposition is exact group algebra.
+#
+# Structure note: the check chain is assembled as tree_sum over the
+# COLLECTED doubling-chain points [2^k]P at the static set bits
+# ([m]P = sum of distinct powers), not as adds interleaved between the
+# scan segments — XLA 0.9.0's CPU pipeline dies with "Unknown MLIR
+# failure" on scan→add→scan chains (reproduced + bisected round 4),
+# while sequential scans plus one trailing tree reduction compile fine.
+#
+# add_unsafe safety for the NEW uses (CLAUDE.md invariant — on top of
+# the scalar_mul2 docstring argument):
+#
+#   * check-chain tree_sum (both groups): every partial sum is
+#     [m1]P for m1 a sub-mask of the fixed scalar's set bits, every
+#     addend [m2]P for a DISJOINT nonzero sub-mask; coincidence needs
+#     m1 ≡ ±m2 (mod r) with m1 ≠ m2, both < 2^128 ≪ r — impossible.
+#   * B01 = Q + (-psi(Q)) precompute: forbidden iff psi(Q) == ±Q, i.e.
+#     [x ∓ 1]Q = O — impossible for genuine G2 points (0 < |x ∓ 1| <
+#     2^65 < r); adversarial non-subgroup points can poison it, but
+#     they fail the psi check in the same kernel (fail-closed z = 0
+#     argument in the endo section above), so a poisoned RLC never
+#     reaches a True verdict.
+#   * MSB accumulator adds (G2 RLC scan): the accumulator is a partial
+#     sum with committed Fiat-Shamir coefficients; engineered
+#     coincidences with the {Q, -psi(Q), B01} addends are the same
+#     2^-250-class events as the module-docstring argument.
+# ---------------------------------------------------------------------------
+
+XSQ = (F.BLS_X * F.BLS_X)  # 128-bit G1 endo-check scalar (positive)
+
+
+def _lsb_set_positions(value: int, nbits: int) -> Tuple[int, ...]:
+    return tuple(i for i in range(nbits) if (value >> i) & 1)
+
+
+def _stack_points(pts_list, ops: Ops) -> Point:
+    """Stack unbatched-or-batched points along a new leading axis."""
+    return tuple(
+        jnp.stack([p[c] for p in pts_list]) for c in range(4)
+    )
+
+
+def _tree_sum_axis0(ops: Ops, pts: Point) -> Point:
+    """Pairwise-halving sum over a SMALL static leading axis (any
+    trailing batch dims; contrast tree_sum, whose identity padding
+    assumes a single batch dim).  add_unsafe safety is the CALLER's
+    obligation for the pair sums it induces."""
+    m = pts[0].shape[0]
+    while m > 1:
+        half = m // 2
+        lo = tuple(x[:half] for x in pts)
+        hi = tuple(x[half : 2 * half] for x in pts)
+        summed = add_unsafe(ops, lo, hi)
+        if m % 2:
+            tail = tuple(x[2 * half :] for x in pts)
+            pts = tuple(
+                jnp.concatenate([s, t]) for s, t in zip(summed, tail)
+            )
+            m = half + 1
+        else:
+            pts = summed
+            m = half
+    return tuple(x[0] for x in pts)
+
+
+def scalar_mul_rlc_g1(base: Point, bits_lsb: jnp.ndarray) -> Tuple[Point, Point]:
+    """([c]P, [x^2]P) per row — LSB-first shared-doubling scan.
+
+    ``bits_lsb``: (..., 128) LSB-first RLC coefficient bits.  One base
+    doubling chain serves both results; the RLC add is conditional per
+    step, and the [x^2]P check chain is the tree_sum of the chain
+    points [2^k]P collected at x^2's 17 static set bits (structure +
+    safety: section notes above).
+    """
+    ops = G1_OPS
+    nbits = bits_lsb.shape[-1]
+    batch = bits_lsb.shape[:-1]
+    acc = identity(ops, batch)
+    started = jnp.zeros(batch, dtype=jnp.int32)
+    xs_all = jnp.moveaxis(bits_lsb, -1, 0)  # (nbits, ...)
+
+    def step(carry, bit):
+        acc, started, cur = carry
+        summed = add_unsafe(ops, (acc[0], acc[1], acc[2], 1 - started), cur)
+        acc = select(bit, summed, acc, ops)
+        started = started | bit
+        return (acc, started, double(ops, cur)), None
+
+    # Segment the scan at the static x^2 set bits: at bit k the carry
+    # holds cur = [2^k]P once steps 0..k-1 have run, so each segment
+    # ends just before a set bit (whose step opens the next segment).
+    positions = _lsb_set_positions(XSQ, nbits)
+    carry = (acc, started, base)
+    curs = []
+    prev = 0
+    for k in positions:
+        if k > prev:
+            carry, _ = jax.lax.scan(step, carry, xs_all[prev:k])
+            prev = k
+        curs.append(carry[2])
+    if prev < nbits:
+        carry, _ = jax.lax.scan(step, carry, xs_all[prev:nbits])
+    acc, started, _ = carry
+    inf = (1 - started) | base[3]
+    scaled = (acc[0], acc[1], acc[2], inf)
+    chain = _tree_sum_axis0(ops, _stack_points(curs, ops))
+    chain = (chain[0], chain[1], chain[2], chain[3] | base[3])
+    return scaled, chain
+
+
+G2_SCAN_NBITS = 65  # max(|x| bits, q = c div |x| bits) for c < 2^128
+
+
+def decompose_g2_scalar(c: int) -> Tuple[int, int]:
+    """Host: RLC coefficient c -> (s, q) with c = q·|x| + s, 0 ≤ s < |x|.
+
+    Then [c]Q = [s]Q + [q][|x|]Q = [s]Q + [q](-psi(Q)) for subgroup Q
+    (psi(Q) = [x]Q, x < 0).  For c < 2^128: q < 2^65, s < 2^64.
+    """
+    q, s = divmod(c, -F.BLS_X)
+    return s, q
+
+
+def scalar_mul_rlc_g2(
+    base: Point, bits_s: jnp.ndarray, bits_q: jnp.ndarray
+) -> Tuple[Point, Point]:
+    """([c]Q, [|x|]Q) per row via the psi decomposition (section notes).
+
+    ``bits_s``/``bits_q``: (..., 65) MSB-first bits of s and q from
+    :func:`decompose_g2_scalar`.  The RLC sum is ONE MSB-first scan —
+    per step one accumulator double and one add_unsafe of the addend
+    selected from {O, Q, -psi(Q), Q-psi(Q)}; the [|x|]Q check chain is
+    the tree_sum of [2^j]Q collected from a double-only chain at |x|'s
+    6 static set bits.  ~129 doubles + ~72 adds replaces the shared
+    128-step scan's 128 doubles + 256 computed adds — and the G2 rows
+    are the most expensive in the flush (every Fq2 op is ~3 Fq muls).
+    """
+    ops = G2_OPS
+    nbits = bits_s.shape[-1]
+    assert bits_s.shape == bits_q.shape and nbits == G2_SCAN_NBITS
+    batch = bits_s.shape[:-1]
+    b0 = base
+    b1 = neg(ops, psi_g2(base))
+    b01 = add_unsafe(ops, b0, b1)  # safety: section notes (psi(Q) != ±Q)
+    acc = identity(ops, batch)
+    xs = (jnp.moveaxis(bits_s, -1, 0), jnp.moveaxis(bits_q, -1, 0))
+
+    def step(acc, bits):
+        sbit, qbit = bits
+        acc = double(ops, acc)
+        both = sbit & qbit
+        addend = select(both, b01, select(sbit, b0, b1, ops), ops)
+        # Identity addend when neither bit is set (and inherit the
+        # base's own identity flag) — add_unsafe routes on the flag.
+        addend = (
+            addend[0],
+            addend[1],
+            addend[2],
+            (1 - (sbit | qbit)) | addend[3],
+        )
+        return add_unsafe(ops, acc, addend), None
+
+    acc, _ = jax.lax.scan(step, acc, xs)
+
+    # [|x|]Q check chain: double-only scan segments over the base,
+    # collecting [2^j]Q at |x|'s set bits, then one tree reduction
+    # (structure + add_unsafe safety: section notes above).
+    def dbl_step(cur, _):
+        return double(ops, cur), None
+
+    curs = []
+    cur = base
+    prev = 0
+    for j in _lsb_set_positions(-F.BLS_X, 64):
+        if j > prev:
+            cur, _ = jax.lax.scan(dbl_step, cur, None, length=j - prev)
+            prev = j
+        curs.append(cur)
+    chain = _tree_sum_axis0(ops, _stack_points(curs, ops))
+
+    # Identity flags: acc started as identity and add_unsafe tracked
+    # flags through every add (a zero scalar leaves the flag set); the
+    # chain inherits the base's flag through doubling.
+    scaled = (acc[0], acc[1], acc[2], acc[3] | base[3])
+    chain = (chain[0], chain[1], chain[2], chain[3] | base[3])
+    return scaled, chain
+
+
 def tree_sum(ops: Ops, pts: Point) -> Point:
     """Sum a batch of points over axis 0 (log2 rounds of add_unsafe)."""
     n = pts[0].shape[0]

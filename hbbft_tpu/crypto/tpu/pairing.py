@@ -50,6 +50,31 @@ assert (P**4 - P**2 + 1) % 3 != 0
 # Bits of |x| below the MSB, MSB-first — the Miller/x-exp schedule.
 X_BITS = np.array([int(b) for b in bin(X_ABS)[3:]], dtype=np.int32)
 
+
+def _bit_runs(bits) -> Tuple[Tuple[int, bool], ...]:
+    """Static run-length form of an MSB-first bit schedule: maximal runs
+    of steps where only the LAST bit is set -> (run_length, ends_set).
+
+    |x| has hamming weight 6, so the 63-step double-and-add schedules
+    (Miller loop, x-exponentiation) are really 63 doubling-class steps
+    with only FIVE add-class steps.  The branch-free scan form this
+    replaces computed the add arm + a select at every step — about half
+    the fixed per-flush pairing cost, paid 58 times for nothing.
+    """
+    runs = []
+    count = 0
+    for b in bits:
+        count += 1
+        if b:
+            runs.append((count, True))
+            count = 0
+    if count:
+        runs.append((count, False))
+    return tuple(runs)
+
+
+X_RUNS = _bit_runs(X_BITS)
+
 FQ12_ONE = np.zeros((6, 2, NL), dtype=np.int32)
 FQ12_ONE[0, 0] = fq.ONE_MONT
 
@@ -134,24 +159,24 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def pow_x_abs(f: jnp.ndarray) -> jnp.ndarray:
-    """f^|x| — square-and-multiply scan over the fixed bit pattern."""
+    """f^|x| — square-only scan runs + a mul at each of the 5 set bits
+    (the static schedule X_RUNS; identical math to bit-at-a-time
+    square-and-multiply, ~45% fewer Fq12 ops)."""
 
-    def step(acc, bit):
-        acc = sqr(acc)
-        return _sel12(bit, mul(acc, f), acc), None
+    def sq(acc, _):
+        return sqr(acc), None
 
-    acc, _ = jax.lax.scan(step, f, jnp.asarray(X_BITS))
+    acc = f
+    for length, ends_set in X_RUNS:
+        acc, _ = jax.lax.scan(sq, acc, None, length=length)
+        if ends_set:
+            acc = mul(acc, f)
     return acc
 
 
 def pow_x(f: jnp.ndarray) -> jnp.ndarray:
     """f^x for the (negative) BLS parameter; f must be unitary."""
     return conj(pow_x_abs(f))
-
-
-def _sel12(flag: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    f = flag.reshape(flag.shape + (1,) * 3).astype(bool)
-    return jnp.where(f, a, b)
 
 
 def is_one(a: jnp.ndarray) -> jnp.ndarray:
@@ -219,21 +244,20 @@ def miller_loop(px: jnp.ndarray, py: jnp.ndarray, qx: jnp.ndarray, qy: jnp.ndarr
         f = sparse_mul(f, l0, l2, l3)
         return X3, Y3, Z3, f
 
-    def step(carry, bit):
-        X, Y, Z, f = carry
-        X, Y, Z, f = dbl_step(X, Y, Z, f)
-        Xa, Ya, Za, fa = add_step(X, Y, Z, f)
-        sel = lambda a, b: _selfq2(bit, a, b)
-        return (sel(Xa, X), sel(Ya, Y), sel(Za, Z), _sel12(bit, fa, f)), None
+    def dbl_only(carry, _):
+        return dbl_step(*carry), None
 
-    (X, Y, Z, f), _ = jax.lax.scan(step, (qx, qy, one, f0), jnp.asarray(X_BITS))
+    # Static X_RUNS schedule: double-only scan runs with the add step
+    # unrolled at the 5 set bits of |x| — same result as the per-bit
+    # branch-free form, without computing + discarding 58 add arms.
+    carry = (qx, qy, one, f0)
+    for length, ends_set in X_RUNS:
+        carry, _ = jax.lax.scan(dbl_only, carry, None, length=length)
+        if ends_set:
+            carry = add_step(*carry)
+    f = carry[3]
     # x < 0: f_{x,Q} = conjugate(f_{|x|,Q})
     return conj(f)
-
-
-def _selfq2(flag: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    f = flag.reshape(flag.shape + (1,) * 2).astype(bool)
-    return jnp.where(f, a, b)
 
 
 # ---------------------------------------------------------------------------

@@ -382,6 +382,39 @@ def test_device_endo_subgroup_matches_oracle():
     want1 = [oc.g1_in_subgroup(j) for j in g1_jacs]
     assert list(map(bool, ok1)) == want1 == [False, True, True, True]
 
+    # Round-4 static-endo scans (the flush kernel's current path): same
+    # verdicts AND correct RLC multiples for the members.  The rogue
+    # rows exercise the fail-closed argument — the psi decomposition is
+    # only sound for subgroup points, so for non-members the check must
+    # reject regardless of what the RLC accumulator contains.
+    rng5 = random.Random(5)
+    coeffs = [rng5.getrandbits(128) for _ in range(n2)]
+    sq = [dc.decompose_g2_scalar(c) for c in coeffs]
+    bs = dc.scalars_to_bits([s for s, _ in sq], dc.G2_SCAN_NBITS)
+    bq = dc.scalars_to_bits([q for _, q in sq], dc.G2_SCAN_NBITS)
+    scaled2b, chain2b = dc.scalar_mul_rlc_g2(pts2, bs, bq)
+    ok2b = np.asarray(dc.endo_subgroup_eq(dc.G2_OPS, pts2, chain2b))
+    assert list(map(bool, ok2b)) == want2
+    for i, j in enumerate(g2_jacs):
+        if want2[i]:
+            assert oc.jac_eq(
+                oc.FQ2_OPS,
+                dc.g2_from_dev(scaled2b, i),
+                oc.jac_mul(oc.FQ2_OPS, j, coeffs[i]),
+            )
+
+    bits1 = dc.scalars_to_bits_lsb(coeffs[:n1], dc.ENDO_NBITS)
+    scaled1b, chain1b = dc.scalar_mul_rlc_g1(pts1, bits1)
+    ok1b = np.asarray(dc.endo_subgroup_eq(dc.G1_OPS, pts1, chain1b))
+    assert list(map(bool, ok1b)) == want1
+    for i, j in enumerate(g1_jacs):
+        if want1[i]:
+            assert oc.jac_eq(
+                oc.FQ_OPS,
+                dc.g1_from_dev(scaled1b, i),
+                oc.jac_mul(oc.FQ_OPS, j, coeffs[i]),
+            )
+
 
 def test_hybrid_backend_routing():
     """HybridBackend: device for big flushes, host for small, host-only
