@@ -115,6 +115,10 @@ _CT_PARSE_CB = ctypes.CFUNCTYPE(
     ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64
 )
 _PRE_CRANK_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64)
+_TAMPER_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ctypes.c_int32, ctypes.c_int32,
+)
 
 
 def _load(words: int) -> Optional[ctypes.CDLL]:
@@ -180,6 +184,27 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     ]
     lib.hbe_queue_dest.restype = ctypes.c_int32
     lib.hbe_queue_dest.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    # tampering adversary (TamperingAdversary mirror)
+    lib.hbe_set_tamper.restype = None
+    lib.hbe_set_tamper.argtypes = [ctypes.c_void_p, _TAMPER_CB]
+    lib.hbe_set_tampered.restype = None
+    lib.hbe_set_tampered.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.hbe_tamper_bval.restype = ctypes.c_int32
+    lib.hbe_tamper_bval.argtypes = [ctypes.c_void_p]
+    lib.hbe_tamper_set_bval.restype = None
+    lib.hbe_tamper_set_bval.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    for name in ("hbe_tamper_flip_root", "hbe_tamper_corrupt_proof"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p]
+    lib.hbe_tamper_share_len.restype = ctypes.c_uint64
+    lib.hbe_tamper_share_len.argtypes = [ctypes.c_void_p]
+    lib.hbe_tamper_share.restype = None
+    lib.hbe_tamper_share.argtypes = [ctypes.c_void_p, u8p]
+    lib.hbe_tamper_set_share.restype = None
+    lib.hbe_tamper_set_share.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
     # delivery profiling counters (BASELINE.md round-3 workflow)
     for name in ("hbe_prof_cycles", "hbe_prof_count"):
         fn = getattr(lib, name)
@@ -464,25 +489,36 @@ class NativeQhbNet:
         # engine queue consumes the SAME stream as the VirtualNet's.
         self._net_rng = rng
         self._adversary = adversary
+        self._tampering = False
         if adversary is not None:
             from hbbft_tpu.net.adversary import (
                 NodeOrderAdversary,
                 NullAdversary,
                 RandomAdversary,
                 ReorderingAdversary,
+                TamperingAdversary,
             )
 
             # EXACT stock types only: the replay reproduces these
             # implementations' rng consumption precisely; a subclass
             # with an overridden pre_crank would silently diverge.
-            if type(adversary) is not NullAdversary:
+            if type(adversary) is TamperingAdversary:
+                # Byzantine mode: faulty nodes run the real algorithm and
+                # the engine offers every outgoing message to _on_tamper,
+                # which consumes the SAME net-rng stream as the Python
+                # TamperingAdversary._drive at the same seed.
+                self._tampering = True
+                self._tamper_cb = _TAMPER_CB(self._on_tamper)
+                lib.hbe_set_tamper(self.handle, self._tamper_cb)
+            elif type(adversary) is not NullAdversary:
                 if type(adversary) not in (
                     ReorderingAdversary, RandomAdversary, NodeOrderAdversary
                 ):
                     raise ValueError(
                         "engine supports the stock scheduling adversaries "
-                        "only (Reordering/Random/NodeOrder); tampering and "
-                        "subclasses run on the Python VirtualNet"
+                        "(Reordering/Random/NodeOrder) and "
+                        "TamperingAdversary; subclasses run on the Python "
+                        "VirtualNet"
                     )
                 if (
                     type(adversary) is RandomAdversary
@@ -552,7 +588,10 @@ class NativeQhbNet:
             )
             self.nodes[i] = _NativeNode(i, qhb, node_rng)
             if i in faulty:
-                lib.hbe_set_silent(self.handle, i, 1)
+                if self._tampering:
+                    lib.hbe_set_tampered(self.handle, i, 1)
+                else:
+                    lib.hbe_set_silent(self.handle, i, 1)
 
     # -- engine callbacks ----------------------------------------------
     def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
@@ -776,6 +815,64 @@ class NativeQhbNet:
             if self._cb_error is None:
                 self._cb_error = exc
 
+    # Engine MsgType values (native/engine.cpp enum MsgType).
+    _MT_VALUE, _MT_ECHO, _MT_READY, _MT_ECHO_HASH, _MT_CAN_DECODE = range(5)
+    _MT_BVAL, _MT_AUX, _MT_CONF, _MT_COIN, _MT_TERM, _MT_DECRYPT = range(5, 11)
+
+    def _on_tamper(
+        self, sender: int, mtype: int, era: int, epoch: int,
+        proposer: int, rnd: int,
+    ) -> None:
+        """Mirror of TamperingAdversary._tamper against the engine's
+        outgoing-message clone — one net-rng draw per TargetedMessage,
+        the same rewrites (flipped bvals/aux/term/conf, doubled shares,
+        corrupted roots/proofs), so a tampered native run consumes the
+        exact rng stream of the Python net at the same seed."""
+        try:
+            adv = self._adversary
+            rng = self._net_rng
+            if rng.random() >= adv.tamper_p:
+                return
+            lib, h = self.lib, self.handle
+            if mtype in (self._MT_BVAL, self._MT_AUX, self._MT_TERM):
+                lib.hbe_tamper_set_bval(h, 0 if lib.hbe_tamper_bval(h) else 1)
+            elif mtype == self._MT_CONF:
+                # BoolSet mask: 1 = {False}, 2 = {True}, 3 = both.
+                if lib.hbe_tamper_bval(h) == 3:
+                    lib.hbe_tamper_set_bval(h, 2 if rng.getrandbits(1) else 1)
+                else:
+                    lib.hbe_tamper_set_bval(h, 3)
+            elif mtype in (self._MT_COIN, self._MT_DECRYPT):
+                # SignatureShare(s.g2 * 2) / DecryptionShare(s.g1 * 2).
+                ln = int(lib.hbe_tamper_share_len(h))
+                buf = (ctypes.c_uint8 * ln)()
+                lib.hbe_tamper_share(h, buf)
+                data = bytes(buf)
+                if self.ext:
+                    el = (
+                        self._dec_g2 if mtype == self._MT_COIN else self._dec_g1
+                    )(data)
+                    out = (el * 2).to_bytes()
+                else:
+                    s = int.from_bytes(data, "big")
+                    out = (2 * s % self._suite.scalar_modulus).to_bytes(
+                        32, "big"
+                    )
+                ob = (ctypes.c_uint8 * len(out)).from_buffer_copy(out)
+                lib.hbe_tamper_set_share(h, ob, len(out))
+            elif mtype in (
+                self._MT_READY, self._MT_ECHO_HASH, self._MT_CAN_DECODE
+            ):
+                lib.hbe_tamper_flip_root(h)
+            elif mtype in (self._MT_VALUE, self._MT_ECHO):
+                lib.hbe_tamper_corrupt_proof(h)
+            else:  # pragma: no cover - no other engine message types
+                return
+            adv.tampered_count += 1
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+
     def _on_pre_crank(self, qlen: int) -> None:
         """Replay the seeded scheduling adversary against the engine
         queue — the exact per-crank rng consumption of the Python
@@ -839,8 +936,8 @@ class NativeQhbNet:
     # -- driving --------------------------------------------------------
     def send_input(self, nid: int, input: Any) -> None:
         nd = self.nodes[nid]
-        if nid in self.faulty_ids:
-            return
+        if nid in self.faulty_ids and not self._tampering:
+            return  # silent (crash-faulty) nodes never act
         step = nd.qhb.handle_input(input, nd.rng)
         nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
         # An input-triggered flush (flush_every=1) runs crypto callbacks;

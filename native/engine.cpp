@@ -803,6 +803,9 @@ const int FUTURE_ERA_BUFFER = 4096;
 struct Node {
   int id;
   bool silent = false;   // crash-faulty / adversary-owned: consumes, never acts
+  bool tampered = false; // Byzantine: runs the real algorithm, but every
+                         // outgoing message is offered to the tamper
+                         // callback (net/adversary.py TamperingAdversary)
   bool has_share = false;
   U256 sk_share = U256_ZERO;              // threshold share (scalar)
   std::vector<U256> pk_shares;            // commitment eval, BY ENGINE ID
@@ -850,6 +853,16 @@ typedef int32_t (*CtParseCb)(int32_t node, const uint8_t* payload,
 // hbe_queue_swap — randomness stays in Python, so the swap stream
 // matches the VirtualNet's at the same seed by construction.
 typedef void (*PreCrankCb)(uint64_t queue_len);
+// Tampering adversary (upstream tests/net/adversary.rs `tamper`; Python
+// mirror net/adversary.py TamperingAdversary): called once per outgoing
+// TargetedMessage of a tamper-marked node (a broadcast counts once, like
+// one Step message).  During the call the engine exposes a PRIVATE clone
+// of the message through the hbe_tamper_* accessors/mutators; whatever
+// the callback leaves in the clone is what the network sees.  Randomness
+// stays in Python, so the decision stream matches the VirtualNet's
+// TamperingAdversary at the same seed by construction.
+typedef void (*TamperCb)(int32_t sender, int32_t type, int32_t era,
+                         int32_t epoch, int32_t proposer, int32_t round);
 
 struct Engine {
   int n = 0, f = 0;
@@ -873,6 +886,8 @@ struct Engine {
   CombineCb combine_cb = nullptr;
   CtParseCb ct_parse_cb = nullptr;
   PreCrankCb pre_crank_cb = nullptr;
+  TamperCb tamper_cb = nullptr;
+  EMsg* cur_tamper = nullptr;  // the clone exposed during tamper_cb
   // requests exposed to Python during verify_cb (pointers into the batch)
   std::vector<const VReq*> cur_vreqs;
   // (index, share bytes) pairs exposed during combine_cb
@@ -912,27 +927,44 @@ struct EngineOps {
   Engine& e;
   Node& node;
 
+  // One shared message object per emission, tampered first when the
+  // sender is adversary-owned.  The tamper callback mutates a clone, so
+  // the sender's OWN state keeps the honest values (exactly the Python
+  // TamperingAdversary, which rewrites step messages after the faulty
+  // node processed them honestly).
+  std::shared_ptr<const EMsg> outgoing(const EMsg& m) {
+    if (node.tampered && e.tamper_cb) {
+      EMsg clone = m;
+      e.cur_tamper = &clone;
+      e.tamper_cb(node.id, (int32_t)m.type, m.era, m.epoch, m.proposer,
+                  m.round);
+      e.cur_tamper = nullptr;
+      return std::make_shared<const EMsg>(std::move(clone));
+    }
+    return std::make_shared<const EMsg>(m);
+  }
+
   // -- emission (drops when a stale-callback guard set suppress_emit) ---
   void send(int dest, const EMsg& m) {
     if (e.suppress_emit) return;
     if (dest == node.id) return;
-    e.queue.push_back({node.id, dest, std::make_shared<const EMsg>(m)});
+    e.queue.push_back({node.id, dest, outgoing(m)});
   }
   void broadcast(const EMsg& m) {
     if (e.suppress_emit) return;
-    auto shared = std::make_shared<const EMsg>(m);
+    auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
       if (d != node.id) e.queue.push_back({node.id, d, shared});
   }
   void broadcast_except(const EMsg& m, const NodeSet& except) {
     if (e.suppress_emit) return;
-    auto shared = std::make_shared<const EMsg>(m);
+    auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
       if (d != node.id && !except.has(d)) e.queue.push_back({node.id, d, shared});
   }
   void send_nodes(const EMsg& m, const NodeSet& dests) {
     if (e.suppress_emit) return;
-    auto shared = std::make_shared<const EMsg>(m);
+    auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
       if (d != node.id && dests.has(d)) e.queue.push_back({node.id, d, shared});
   }
@@ -2630,6 +2662,8 @@ void engine_flush_pool(Engine& e, Node& node) {
 // nodes with pending requests in sorted-id order; per node, drain the
 // pool in rounds (one verify-batch callback per round, continuations in
 // submission order; continuations may refill the pool).
+void engine_flush_ext_node(Engine& e, Node& node);
+
 void engine_flush_ext(Engine& e) {
   if (e.in_flush) return;  // re-entrancy (a propose inside a batch cb)
   e.in_flush = true;
@@ -2639,26 +2673,9 @@ void engine_flush_ext(Engine& e) {
     any = false;
     for (int nid = 0; nid < e.n; ++nid) {
       Node& node = e.nodes[nid];
-      while (!node.pool.empty()) {
+      if (!node.pool.empty()) {
         any = true;
-        std::vector<Pending> items;
-        items.swap(node.pool);
-        e.pool_items -= items.size();
-        std::vector<uint8_t> verdicts;
-        int need = 0;
-        for (Pending& p : items)
-          if (p.need_verdict) ++need;
-        if (need) {
-          e.cur_vreqs.clear();
-          for (Pending& p : items)
-            if (p.need_verdict) e.cur_vreqs.push_back(&p.req);
-          verdicts.assign(need, 0);
-          e.verify_cb(nid, need, verdicts.data());
-          e.cur_vreqs.clear();
-        }
-        int vi = 0;
-        for (Pending& p : items)
-          p.run(p.need_verdict ? verdicts[vi++] != 0 : p.pre_ok);
+        engine_flush_ext_node(e, node);
       }
     }
   }
@@ -2680,6 +2697,35 @@ inline void engine_count_unit(Engine& e) {
   }
 }
 
+// Ext-mode eager flush of ONE node's pool: drain in rounds (one
+// verify-batch callback per round, continuations in submission order;
+// continuations may refill the pool).  Used by engine_flush_ext for
+// every node and directly for tampered nodes (VirtualNet's
+// TamperingAdversary drains the faulty node's own pool inside _drive,
+// independent of the global flush cadence).
+void engine_flush_ext_node(Engine& e, Node& node) {
+  while (!node.pool.empty()) {
+    std::vector<Pending> items;
+    items.swap(node.pool);
+    e.pool_items -= items.size();
+    std::vector<uint8_t> verdicts;
+    int need = 0;
+    for (Pending& p : items)
+      if (p.need_verdict) ++need;
+    if (need) {
+      e.cur_vreqs.clear();
+      for (Pending& p : items)
+        if (p.need_verdict) e.cur_vreqs.push_back(&p.req);
+      verdicts.assign(need, 0);
+      e.verify_cb(node.id, need, verdicts.data());
+      e.cur_vreqs.clear();
+    }
+    int vi = 0;
+    for (Pending& p : items)
+      p.run(p.need_verdict ? verdicts[vi++] != 0 : p.pre_ok);
+  }
+}
+
 void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
   // One top-level processing unit: handler, then batch events, then the
   // eager pool flush (each flush callback fires its own events).
@@ -2688,6 +2734,7 @@ void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
   fn(ctx);
   ctx.commit_events();
   if (!e.ext) engine_flush_pool(e, node);
+  else if (node.tampered) engine_flush_ext_node(e, node);
   e.depth--;
 }
 
@@ -2709,7 +2756,11 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     ++processed;
     Node& node = e.nodes[item.dest];
     if (node.silent) continue;
-    e.delivered++;
+    // Adversary-owned (tampered) destinations mirror the VirtualNet's
+    // faulty path: the node runs the real algorithm, but the delivery
+    // neither counts toward `delivered` nor ticks the flush cadence
+    // (VirtualNet.crank returns before delivered+=1 / _maybe_flush).
+    if (!node.tampered) e.delivered++;
     node.handled++;
     uint64_t t0 = prof_tick();
     engine_unit(e, node,
@@ -2717,7 +2768,7 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     int ty = item.msg->type & 15;
     e.prof_cycles[ty] += prof_tick() - t0;
     e.prof_count[ty] += 1;
-    engine_count_unit(e);
+    if (!node.tampered) engine_count_unit(e);
   }
   return processed;
 }
@@ -2898,7 +2949,10 @@ int32_t hbe_propose(void* h, int32_t node, int32_t era, const uint8_t* payload,
     ctx.commit_events();
   } else {
     engine_unit(*e, nd, [&](Ctx& ctx) { ctx.hb_propose(data); });
-    engine_count_unit(*e);  // VirtualNet.send_input's _maybe_flush
+    // VirtualNet.send_input's _maybe_flush; adversary-driven inputs to
+    // faulty nodes (broadcast_input's on_input_to_faulty path) don't
+    // tick the flush counter.
+    if (!nd.tampered) engine_count_unit(*e);
   }
   return 1;
 }
@@ -2971,6 +3025,85 @@ void hbe_queue_swap(void* h, uint64_t i, uint64_t j) {
 int32_t hbe_queue_dest(void* h, uint64_t i) {
   Engine* e = (Engine*)h;
   return i < e->queue.size() ? e->queue[i].dest : -1;
+}
+
+// -- tampering adversary ----------------------------------------------------
+//
+// hbe_set_tamper installs the callback; hbe_set_tampered marks a node
+// adversary-owned (it keeps running the real algorithm — contrast
+// hbe_set_silent).  The hbe_tamper_* accessors/mutators are valid ONLY
+// during a TamperCb call and act on the private clone of the outgoing
+// message (net/adversary.py TamperingAdversary's rewrite set: flipped
+// bvals/aux/term/conf, doubled shares, corrupted roots and proofs).
+
+void hbe_set_tamper(void* h, TamperCb cb) { ((Engine*)h)->tamper_cb = cb; }
+
+void hbe_set_tampered(void* h, int32_t node, int32_t flag) {
+  ((Engine*)h)->nodes[node].tampered = flag != 0;
+}
+
+int32_t hbe_tamper_bval(void* h) {
+  Engine* e = (Engine*)h;
+  return e->cur_tamper ? e->cur_tamper->bval : -1;
+}
+
+void hbe_tamper_set_bval(void* h, int32_t v) {
+  Engine* e = (Engine*)h;
+  if (e->cur_tamper) e->cur_tamper->bval = (uint8_t)v;
+}
+
+// Flip the low bit of the first root byte (adversary.py flip_root).
+void hbe_tamper_flip_root(void* h) {
+  Engine* e = (Engine*)h;
+  if (e->cur_tamper) e->cur_tamper->root[0] ^= 1;
+}
+
+// Corrupt the Merkle proof's leaf value (adversary.py ValueMsg/EchoMsg
+// branch: flip the first byte, or b"\x01" for an empty value).  Clones
+// the shared ProofData — other queue references keep the honest proof —
+// and resets the validity memo (it is keyed to the object).
+void hbe_tamper_corrupt_proof(void* h) {
+  Engine* e = (Engine*)h;
+  if (!e->cur_tamper || !e->cur_tamper->proof) return;
+  auto bad = std::make_shared<ProofData>(*e->cur_tamper->proof);
+  if (bad->value.empty())
+    bad->value = Bytes(1, '\x01');
+  else
+    bad->value[0] ^= 1;
+  bad->valid_memo = -1;
+  bad->valid_n = 0;
+  e->cur_tamper->proof = std::move(bad);
+}
+
+// Share accessors: scalar mode exposes the 32-byte BE scalar; external
+// mode the opaque share bytes.  The setter replaces whichever is live.
+uint64_t hbe_tamper_share_len(void* h) {
+  Engine* e = (Engine*)h;
+  if (!e->cur_tamper) return 0;
+  if (e->cur_tamper->share_b) return e->cur_tamper->share_b->size();
+  return 32;
+}
+
+void hbe_tamper_share(void* h, uint8_t* out) {
+  Engine* e = (Engine*)h;
+  if (!e->cur_tamper) return;
+  if (e->cur_tamper->share_b) {
+    std::memcpy(out, e->cur_tamper->share_b->data(),
+                e->cur_tamper->share_b->size());
+    return;
+  }
+  u256_to_be32(e->cur_tamper->share, out);
+}
+
+void hbe_tamper_set_share(void* h, const uint8_t* data, uint64_t len) {
+  Engine* e = (Engine*)h;
+  if (!e->cur_tamper) return;
+  if (e->cur_tamper->share_b) {
+    e->cur_tamper->share_b =
+        std::make_shared<const Bytes>((const char*)data, len);
+    return;
+  }
+  e->cur_tamper->share = u256_from_be(data, len);
 }
 
 uint64_t hbe_pending_verifies(void* h) { return ((Engine*)h)->pool_items; }

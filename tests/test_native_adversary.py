@@ -87,6 +87,89 @@ def test_equivalence_under_scheduling_adversary(adv_name, n, f, seed):
     nat.close()
 
 
+@pytest.mark.parametrize("n,f,seed,tp", [(7, 2, 5, 1.0), (10, 3, 6, 0.5)])
+def test_equivalence_under_tampering_adversary(n, f, seed, tp):
+    """Round-4 VERDICT item #8: the engine's parse/fault paths face
+    hostile (valid-type, wrong-content) bytes from Byzantine senders,
+    and the run stays byte-identical to the Python VirtualNet under the
+    same seeded TamperingAdversary — batches, fault logs, deliveries."""
+    from hbbft_tpu.net.adversary import TamperingAdversary
+
+    pynet = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(f)
+        .max_cranks(10_000_000)
+        .adversary(TamperingAdversary(tamper_p=tp))
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni, sink, batch_size=BATCH_SIZE, session_id=SESSION
+            )
+        )
+        .build()
+    )
+    py_adv = pynet.adversary
+    nat_adv = TamperingAdversary(tamper_p=tp)
+    nat = native_engine.NativeQhbNet(
+        n, seed=seed, batch_size=BATCH_SIZE, num_faulty=f, session_id=SESSION,
+        adversary=nat_adv,
+    )
+    # broadcast_input order: correct ids first, then faulty through the
+    # adversary (VirtualNet.broadcast_input).
+    for k in range(2):
+        pynet.broadcast_input(lambda nid, k=k: Input.user(f"t{nid}.{k}"))
+        for nid in sorted(nat.correct_ids) + sorted(nat.faulty_ids):
+            nat.send_input(nid, Input.user(f"t{nid}.{k}"))
+    pynet.crank_until(
+        lambda net: all(len(py_batches(net, i)) >= 2 for i in net.correct_ids),
+        max_cranks=10_000_000,
+    )
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 2 for i in e.correct_ids),
+        chunk=1,
+    )
+    for nid in pynet.correct_ids:
+        assert [batch_key(b) for b in py_batches(pynet, nid)] == [
+            batch_key(b) for b in nat.nodes[nid].outputs
+        ], f"node {nid} batches diverge under tampering"
+        assert [(x.node_id, x.kind) for x in pynet.node(nid).faults] == nat.faults(
+            nid
+        ), f"node {nid} fault logs diverge under tampering"
+    assert nat.delivered == pynet.delivered
+    # the adversary actually rewrote traffic, identically on both sides
+    assert nat_adv.tampered_count == py_adv.tampered_count > 0
+    # evidence only ever names faulty nodes
+    for nid in pynet.correct_ids:
+        assert {s for s, _ in nat.faults(nid)} <= set(nat.faulty_ids)
+    nat.close()
+
+
+def test_tampering_with_external_crypto():
+    """Tampered Byzantine traffic + the external-crypto path compose:
+    same outputs and faults as the internal-scalar engine run."""
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.net.adversary import TamperingAdversary
+
+    def drive(**kw):
+        nat = native_engine.NativeQhbNet(
+            7, seed=9, batch_size=BATCH_SIZE, num_faulty=2, session_id=SESSION,
+            adversary=TamperingAdversary(tamper_p=0.5), **kw,
+        )
+        for nid in sorted(nat.correct_ids) + sorted(nat.faulty_ids):
+            nat.send_input(nid, Input.user(f"x{nid}"))
+        nat.run_until(
+            lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+            chunk=1,
+        )
+        out = (
+            {i: [batch_key(b) for b in nat.nodes[i].outputs] for i in nat.correct_ids},
+            {i: nat.faults(i) for i in range(7)},
+        )
+        nat.close()
+        return out
+
+    assert drive() == drive(suite=ScalarSuite(), external_crypto=True)
+
+
 def test_reordering_with_external_crypto():
     """Adversarial schedule + the external-crypto path together (scalar
     suite): the two features compose without breaking equivalence."""
