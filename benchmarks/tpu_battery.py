@@ -99,7 +99,7 @@ def run_step(name: str, argv: list[str], env: dict, timeout_s: float, sink) -> N
 
 
 def main() -> None:
-    tag = os.environ.get("BATTERY_TAG", "r03")
+    tag = os.environ.get("BATTERY_TAG", "r04")
     out_path = os.path.join(ROOT, f"BATTERY_{tag}.jsonl")
     ok, note = probe_tpu()
     with open(out_path, "a") as sink:
@@ -119,6 +119,20 @@ def main() -> None:
         # and a cold step can need two; a warm single-size bench.py run
         # is ~8 min wall (cache deserialization + relay latency).
         py = sys.executable
+        # Round-4 kernels (static-endo + psi-split scans, run-length
+        # Miller/final-exp) are NEW graphs: every flush bucket recompiles
+        # once (~10 min/bucket on this host, persisted).  The sweep
+        # sizes run smallest-first so the battery records the full
+        # batch-scaling curve of the new kernel even if a later step
+        # times out; 10240 reuses the 2048 + 4096 chunk buckets.
+        run_step(
+            "bench_flush_512", [py, "bench.py"],
+            {"BENCH_SHARES": "512", "BENCH_DEADLINE_S": "2400"}, 2700, sink,
+        )
+        run_step(
+            "bench_flush_2048", [py, "bench.py"],
+            {"BENCH_SHARES": "2048", "BENCH_DEADLINE_S": "2400"}, 2700, sink,
+        )
         run_step(
             "bench_flush_headline", [py, "bench.py"],
             {"BENCH_DEADLINE_S": "2400"}, 2700, sink,
