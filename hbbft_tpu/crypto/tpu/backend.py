@@ -35,6 +35,7 @@ Replaces the per-share CPU pairing checks of upstream
 
 from __future__ import annotations
 
+import os
 import warnings
 from functools import lru_cache
 from typing import Any, Dict, List, Sequence, Tuple
@@ -295,12 +296,15 @@ class TpuBackend(CryptoBackend):
 
     # -- public API ----------------------------------------------------
 
-    # Per-flush device sweet spot (measured, TPU v5e, BASELINE.md round-3
-    # battery): the 16384-row bucket costs ~1.8x more per ROW than 2048
-    # (scan working set vs HBM), and power-of-two padding above the chunk
-    # wastes up to 60% of rows — so giant flushes are split and verified
-    # chunk-by-chunk, each with its own Fiat-Shamir coefficients.
-    CHUNK = 4096
+    # Per-flush device sweet spot (measured on the chip, BASELINE.md
+    # round-4 battery): giant flushes split into chunks, each with its
+    # own Fiat-Shamir coefficients, because per-row scan cost grows
+    # with the bucket's working set (HBM pressure).  The round-4 kernel
+    # moved the optimum from 4096 to 2048 (10240 shares: 1516/s at
+    # 2048-chunks vs 1085/s at 4096 — the smaller bucket's per-row win
+    # now outweighs the extra fixed pairing stages).  HBBFT_TPU_CHUNK
+    # overrides for re-tuning.
+    CHUNK = max(1, int(os.environ.get("HBBFT_TPU_CHUNK", "2048")))
 
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
         reqs = list(reqs)

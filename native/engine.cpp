@@ -667,6 +667,26 @@ struct Bcast {
   std::map<int, Root> readys;
   std::vector<Root> ready_root_order;  // first-seen order of distinct roots
   std::map<int, Root> can_decode;
+  // Incremental per-root tallies (distinct roots stay O(1) in honest
+  // runs).  The maps above were walked on EVERY echo/ready delivery to
+  // recount — an O(N) rb-tree + ProofData pointer chase per message,
+  // O(N^3) network-wide, and the profiled bound past N=256.  Counts
+  // are derived data only; map/iteration semantics are unchanged.
+  std::vector<std::pair<Root, int>> echo_full_by_root;  // full-proof echos
+  std::vector<std::pair<Root, int>> echo_any_by_root;   // echos + echo_hashes
+  std::vector<std::pair<Root, int>> ready_by_root;
+
+  static int bump(std::vector<std::pair<Root, int>>& v, const Root& r) {
+    for (auto& kv : v)
+      if (kv.first == r) return ++kv.second;
+    v.push_back({r, 1});
+    return 1;
+  }
+  static int tally(const std::vector<std::pair<Root, int>>& v, const Root& r) {
+    for (auto& kv : v)
+      if (kv.first == r) return kv.second;
+    return 0;
+  }
   bool can_decode_sent = false;
   bool echo_sent = false;
   bool ready_sent = false;
@@ -1895,13 +1915,12 @@ struct Ctx {
     bc_handle_echo(st, proposer, bc, node.id, proof);
   }
 
+  // Distinct senders per root via the incremental tally (a sender may
+  // appear in BOTH echos and echo_hashes for the same root — the
+  // EchoHash-then-full-Echo order — and is tallied once; see the
+  // hit-guarded bump in bc_handle_echo).
   int bc_echo_count(const Bcast& bc, const Root& root) {
-    NodeSet senders;
-    for (auto& kv : bc.echos)
-      if (kv.second->root == root) senders.add(kv.first);
-    for (auto& kv : bc.echo_hashes)
-      if (kv.second == root) senders.add(kv.first);
-    return senders.count();
+    return Bcast::tally(bc.echo_any_by_root, root);
   }
 
   void bc_handle_echo(EpochState& st, int proposer, Bcast& bc, int sender,
@@ -1928,6 +1947,11 @@ struct Ctx {
       return;
     }
     bc.echos[sender] = proof;
+    Bcast::bump(bc.echo_full_by_root, proof->root);
+    // A same-root EchoHash from this sender was already tallied in
+    // echo_any_by_root (the union count de-duplicates senders).
+    if (hit == bc.echo_hashes.end())
+      Bcast::bump(bc.echo_any_by_root, proof->root);
     bc_maybe_can_decode(st, proposer, bc, proof->root);
     if (bc_echo_count(bc, proof->root) >= n() - f() && !bc.ready_sent)
       bc_send_ready(st, proposer, bc, proof->root);
@@ -1943,6 +1967,7 @@ struct Ctx {
       return;
     }
     bc.echo_hashes[sender] = root;
+    Bcast::bump(bc.echo_any_by_root, root);
     if (bc_echo_count(bc, root) >= n() - f() && !bc.ready_sent)
       bc_send_ready(st, proposer, bc, root);
     bc_try_decode(st, proposer, bc);
@@ -1964,10 +1989,10 @@ struct Ctx {
                            const Root& root) {
     if (bc.can_decode_sent || bc.terminated) return;
     if (!node.has_share) return;  // observers stay silent (is_validator)
-    int shards = 0;
-    for (auto& kv : bc.echos)
-      if (kv.second->root == root) ++shards;
-    if (shards >= bc.data_shards) {
+    // Full-proof echos carry distinct shard indices (wrong-index echos
+    // are faulted before insertion), so the per-root echo tally IS the
+    // distinct-shard count.
+    if (Bcast::tally(bc.echo_full_by_root, root) >= bc.data_shards) {
       bc.can_decode_sent = true;
       bc_send_root(st, proposer, BC_CAN_DECODE, root, -1);
     }
@@ -1981,16 +2006,8 @@ struct Ctx {
       return;
     }
     bc.readys[sender] = root;
-    bool seen = false;
-    for (const Root& r : bc.ready_root_order)
-      if (r == root) {
-        seen = true;
-        break;
-      }
-    if (!seen) bc.ready_root_order.push_back(root);
-    int count = 0;
-    for (auto& kv : bc.readys)
-      if (kv.second == root) ++count;
+    int count = Bcast::bump(bc.ready_by_root, root);
+    if (count == 1) bc.ready_root_order.push_back(root);
     if (count >= f() + 1 && !bc.ready_sent)
       bc_send_ready(st, proposer, bc, root);
     bc_try_decode(st, proposer, bc);
@@ -2007,10 +2024,11 @@ struct Ctx {
     if (bc.terminated) return;
     // Counter(readys.values()) iterates distinct roots in first-seen order.
     for (const Root& root : bc.ready_root_order) {
-      int count = 0;
-      for (auto& kv : bc.readys)
-        if (kv.second == root) ++count;
-      if (count < 2 * f() + 1) continue;
+      if (Bcast::tally(bc.ready_by_root, root) < 2 * f() + 1) continue;
+      // Cheap tally gate before walking echos: distinct shard indices
+      // per root == full-echo count (see bc_maybe_can_decode).
+      if (Bcast::tally(bc.echo_full_by_root, root) < bc.data_shards)
+        continue;
       // Reference the shard bytes in place — materializing copies on
       // every decode attempt dominated big-payload (DKG) epochs.
       std::map<int, const Bytes*> shards;  // index -> value (last write wins)
