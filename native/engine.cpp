@@ -811,11 +811,23 @@ struct VReq {
   std::shared_ptr<const Bytes> share;  // VK_SIG/VK_DEC: wire share bytes
 };
 
+// Flat continuation (round 4): COIN/DECRYPT deliveries dominated the
+// full-epoch cycle profile (~2.4k cycles each vs ~400 for BVAL/AUX),
+// largely the std::function continuation each pool entry heap-allocated
+// with ~9 captures.  A tagged struct + switch dispatch (pending_run)
+// keeps the same three continuation targets without the allocation.
+enum ContKind : uint8_t { CONT_TS = 0, CONT_TD_CT = 1, CONT_TD_SHARE = 2 };
+
 struct Pending {
   bool need_verdict = false;  // true: external mode, verdict from flush cb
   bool pre_ok = false;        // scalar mode: verdict computed at submit
+  uint8_t cont = CONT_TS;
+  int32_t era = 0, epoch = 0, proposer = 0, rnd = 0, sender = -1;
   VReq req;
-  std::function<void(bool)> run;
+  std::shared_ptr<Ts> ts;    // CONT_TS (keeps req.doc alive)
+  std::shared_ptr<Td> td;    // CONT_TD_* (keeps req.ct alive)
+  U256 share = U256_ZERO;    // scalar-mode share
+  std::shared_ptr<const Bytes> share_b;  // ext-mode share
 };
 
 const int FUTURE_ERA_BUFFER = 4096;
@@ -1242,38 +1254,27 @@ struct Ctx {
       return;
     }
     ts->seen.add(sender);
-    int era = node.era, epoch = st.epoch, rnd = ba.round;
-    Engine* eng = &e;
-    Node* nd = &node;
     Pending p;
+    p.cont = CONT_TS;
+    p.era = node.era;
+    p.epoch = st.epoch;
+    p.proposer = proposer;
+    p.rnd = ba.round;
+    p.sender = sender;
+    p.ts = ts;
     if (e.ext) {
-      std::shared_ptr<const Bytes> share_b =
-          m.share_b ? m.share_b : std::make_shared<const Bytes>();
+      p.share_b = m.share_b ? m.share_b : std::make_shared<const Bytes>();
       p.need_verdict = true;
       p.req.kind = VK_SIG;
-      p.req.era = era;
+      p.req.era = p.era;
       p.req.sender = sender;
-      p.req.doc = &ts->doc;  // Ts kept alive by the continuation below
-      p.req.share = share_b;
-      p.run = [eng, nd, era, epoch, proposer, rnd, ts, sender,
-               share_b](bool ok) {
-        Ctx c(*eng, *nd);
-        c.ts_verified_cb(era, epoch, proposer, rnd, ts, sender, U256_ZERO,
-                         share_b, ok);
-        c.commit_events();
-      };
+      p.req.doc = &ts->doc;  // Ts kept alive by p.ts
+      p.req.share = p.share_b;
     } else {
       // Deferred verification: compute the verdict now (order-independent
       // scalar check), run the protocol callback at flush (pool order).
-      U256 share = m.share;
-      p.pre_ok = share == mulmod(node.pk_shares[sender], ts->doc_h);
-      p.run = [eng, nd, era, epoch, proposer, rnd, ts, sender,
-               share](bool ok) {
-        Ctx c(*eng, *nd);
-        c.ts_verified_cb(era, epoch, proposer, rnd, ts, sender, share,
-                         nullptr, ok);
-        c.commit_events();
-      };
+      p.share = m.share;
+      p.pre_ok = p.share == mulmod(node.pk_shares[sender], ts->doc_h);
     }
     pool_push(e, node, std::move(p));
   }
@@ -2138,17 +2139,13 @@ struct Ctx {
     td->has_ct = true;
     td->ct = ct;
     td->ct_h = ct_hash_scalar(ct);
-    bool ok = td->ct.w == mulmod(td->ct.u, td->ct_h);  // validity pairing
-    int era = node.era, epoch = st.epoch;
-    Engine* eng = &e;
-    Node* nd = &node;
     Pending p;
-    p.pre_ok = ok;
-    p.run = [eng, nd, era, epoch, proposer, td](bool ok2) {
-      Ctx c(*eng, *nd);
-      c.td_ct_checked_cb(era, epoch, proposer, td, ok2);
-      c.commit_events();
-    };
+    p.cont = CONT_TD_CT;
+    p.era = node.era;
+    p.epoch = st.epoch;
+    p.proposer = proposer;
+    p.td = td;
+    p.pre_ok = td->ct.w == mulmod(td->ct.u, td->ct_h);  // validity pairing
     pool_push(e, node, std::move(p));
   }
 
@@ -2159,19 +2156,16 @@ struct Ctx {
     if (td->has_ct || td->terminated) return;
     td->has_ct = true;
     td->ct_payload = payload;
-    int era = node.era, epoch = st.epoch;
-    Engine* eng = &e;
-    Node* nd = &node;
     Pending p;
+    p.cont = CONT_TD_CT;
+    p.era = node.era;
+    p.epoch = st.epoch;
+    p.proposer = proposer;
+    p.td = td;
     p.need_verdict = true;
     p.req.kind = VK_CT;
-    p.req.era = era;
-    p.req.ct = td->ct_payload.get();  // Td kept alive by the continuation
-    p.run = [eng, nd, era, epoch, proposer, td](bool ok) {
-      Ctx c(*eng, *nd);
-      c.td_ct_checked_cb(era, epoch, proposer, td, ok);
-      c.commit_events();
-    };
+    p.req.era = p.era;
+    p.req.ct = td->ct_payload.get();  // Td kept alive by p.td
     pool_push(e, node, std::move(p));
   }
 
@@ -2233,36 +2227,36 @@ struct Ctx {
 
   void td_submit_share(int era, int epoch, int proposer, std::shared_ptr<Td> td,
                        int sender, const U256& share) {
-    bool ok = mulmod(share, td->ct_h) == mulmod(node.pk_shares[sender], td->ct.w);
-    Engine* eng = &e;
-    Node* nd = &node;
     Pending p;
-    p.pre_ok = ok;
-    p.run = [eng, nd, era, epoch, proposer, td, sender, share](bool ok2) {
-      Ctx c(*eng, *nd);
-      c.td_verified_cb(era, epoch, proposer, td, sender, share, nullptr, ok2);
-      c.commit_events();
-    };
+    p.cont = CONT_TD_SHARE;
+    p.era = era;
+    p.epoch = epoch;
+    p.proposer = proposer;
+    p.sender = sender;
+    p.td = td;
+    p.share = share;
+    p.pre_ok =
+        mulmod(share, td->ct_h) == mulmod(node.pk_shares[sender], td->ct.w);
     pool_push(e, node, std::move(p));
   }
 
   void td_submit_share_ext(int era, int epoch, int proposer,
                            std::shared_ptr<Td> td, int sender,
                            std::shared_ptr<const Bytes> share_b) {
-    Engine* eng = &e;
-    Node* nd = &node;
     Pending p;
+    p.cont = CONT_TD_SHARE;
+    p.era = era;
+    p.epoch = epoch;
+    p.proposer = proposer;
+    p.sender = sender;
+    p.td = td;
+    p.share_b = share_b;
     p.need_verdict = true;
     p.req.kind = VK_DEC;
     p.req.era = era;
     p.req.sender = sender;
     p.req.ct = td->ct_payload.get();
-    p.req.share = share_b;
-    p.run = [eng, nd, era, epoch, proposer, td, sender, share_b](bool ok) {
-      Ctx c(*eng, *nd);
-      c.td_verified_cb(era, epoch, proposer, td, sender, share_b, ok);
-      c.commit_events();
-    };
+    p.req.share = p.share_b;
     pool_push(e, node, std::move(p));
   }
 
@@ -2289,12 +2283,6 @@ struct Ctx {
       hb_advance();
     }
     if (!live) e.suppress_emit--;
-  }
-
-  void td_verified_cb(int era, int epoch, int proposer, std::shared_ptr<Td> td,
-                      int sender, std::shared_ptr<const Bytes> share_b,
-                      bool ok) {
-    td_verified_cb(era, epoch, proposer, td, sender, U256_ZERO, share_b, ok);
   }
 
   void td_handle_message(EpochState& st, int proposer, std::shared_ptr<Td> td,
@@ -2662,6 +2650,26 @@ struct Ctx {
 // Top-level engine driving
 // ===========================================================================
 
+// Flat-continuation dispatch (see Pending): the three verified-callback
+// targets, constructed without a per-entry std::function allocation.
+void pending_run(Engine& e, Node& node, Pending& p, bool ok) {
+  Ctx c(e, node);
+  switch (p.cont) {
+    case CONT_TS:
+      c.ts_verified_cb(p.era, p.epoch, p.proposer, p.rnd, p.ts, p.sender,
+                       p.share, p.share_b, ok);
+      break;
+    case CONT_TD_CT:
+      c.td_ct_checked_cb(p.era, p.epoch, p.proposer, p.td, ok);
+      break;
+    case CONT_TD_SHARE:
+      c.td_verified_cb(p.era, p.epoch, p.proposer, p.td, p.sender, p.share,
+                       p.share_b, ok);
+      break;
+  }
+  c.commit_events();
+}
+
 void engine_flush_pool(Engine& e, Node& node) {
   while (!node.pool.empty()) {
     std::vector<Pending> items;
@@ -2669,7 +2677,7 @@ void engine_flush_pool(Engine& e, Node& node) {
     e.pool_items -= items.size();
     for (Pending& p : items) {
       uint64_t t0 = prof_tick();
-      p.run(p.pre_ok);
+      pending_run(e, node, p, p.pre_ok);
       e.prof_cycles[14] += prof_tick() - t0;
       e.prof_count[14]++;
     }
@@ -2740,7 +2748,7 @@ void engine_flush_ext_node(Engine& e, Node& node) {
     }
     int vi = 0;
     for (Pending& p : items)
-      p.run(p.need_verdict ? verdicts[vi++] != 0 : p.pre_ok);
+      pending_run(e, node, p, p.need_verdict ? verdicts[vi++] != 0 : p.pre_ok);
   }
 }
 
