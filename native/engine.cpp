@@ -2678,8 +2678,17 @@ void engine_flush_pool(Engine& e, Node& node) {
     for (Pending& p : items) {
       uint64_t t0 = prof_tick();
       pending_run(e, node, p, p.pre_ok);
-      e.prof_cycles[14] += prof_tick() - t0;
+      uint64_t dt = prof_tick() - t0;
+      e.prof_cycles[14] += dt;
       e.prof_count[14]++;
+      // Continuation tail split (era-change diagnosis, CLAUDE.md r4):
+      // slot 13 tallies continuations costing > 1M cycles (the
+      // big-payload decrypt/decode events); slot 11 keeps the max.
+      if (dt > 1000000) {
+        e.prof_cycles[13] += dt;
+        e.prof_count[13]++;
+      }
+      if (dt > e.prof_cycles[11]) e.prof_cycles[11] = dt;
     }
   }
 }
