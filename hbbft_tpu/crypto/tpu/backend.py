@@ -304,7 +304,16 @@ class TpuBackend(CryptoBackend):
     # 2048-chunks vs 1085/s at 4096 — the smaller bucket's per-row win
     # now outweighs the extra fixed pairing stages).  HBBFT_TPU_CHUNK
     # overrides for re-tuning.
-    CHUNK = max(1, int(os.environ.get("HBBFT_TPU_CHUNK", "2048")))
+    try:
+        CHUNK = max(1, int(os.environ.get("HBBFT_TPU_CHUNK", "2048")))
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            "HBBFT_TPU_CHUNK is not an integer; falling back to 2048",
+            stacklevel=1,
+        )
+        CHUNK = 2048
 
     def verify_batch(self, reqs: Sequence[VerifyRequest]) -> List[bool]:
         reqs = list(reqs)

@@ -390,10 +390,15 @@ def scalars_to_bits_lsb(scalars, nbits: int) -> jnp.ndarray:
 #     they fail the psi check in the same kernel (fail-closed z = 0
 #     argument in the endo section above), so a poisoned RLC never
 #     reaches a True verdict.
-#   * MSB accumulator adds (G2 RLC scan): the accumulator is a partial
-#     sum with committed Fiat-Shamir coefficients; engineered
-#     coincidences with the {Q, -psi(Q), B01} addends are the same
-#     2^-250-class events as the module-docstring argument.
+#   * MSB accumulator adds (G2 RLC scan): deterministically impossible,
+#     not merely improbable.  After the step's double the accumulator is
+#     [2m]Q with m = s_k + |x|*q_k (k-bit MSB prefixes, k <= 64, so
+#     2m < 2^131 << r: a mod-r wrap 2m = r - t is out of reach and any
+#     coincidence must hold over the integers).  The addend scalars are
+#     1 and |x|+1 (both odd — never equal to the even 2m) or |x| (even:
+#     needs m = |x|/2, which forces q_k = 0 and s_k = |x|/2; but |x|/2
+#     has 63 bits, so k >= 63 and s >= 2^(64-k) * |x|/2 >= |x|,
+#     contradicting the decomposition's 0 <= s < |x|).
 # ---------------------------------------------------------------------------
 
 XSQ = (F.BLS_X * F.BLS_X)  # 128-bit G1 endo-check scalar (positive)
@@ -444,6 +449,13 @@ def scalar_mul_rlc_g1(base: Point, bits_lsb: jnp.ndarray) -> Tuple[Point, Point]
     """
     ops = G1_OPS
     nbits = bits_lsb.shape[-1]
+    # The [x^2]P check chain reads chain points at x^2's set bits; a
+    # narrower scan would silently truncate the check scalar and reject
+    # every genuine point (fail-closed but undiagnosable) — mirror the
+    # G2 scan's width assertion instead.
+    assert nbits >= XSQ.bit_length(), (
+        f"RLC bit width {nbits} < x^2 width {XSQ.bit_length()}"
+    )
     batch = bits_lsb.shape[:-1]
     acc = identity(ops, batch)
     started = jnp.zeros(batch, dtype=jnp.int32)

