@@ -1,0 +1,138 @@
+"""Real-BLS era change at configurable N: wall + pairing/DKG split.
+
+Round-4 VERDICT weak #2 / next-round #6: the decision to skip DKG
+batching rests on an N=4 profile (75% pairing / 19% DKG), but the DKG
+ack/row term grows ~N^3 while the pairing plane amortizes better with
+batch size — so the split must be measured at larger N before the
+conclusion can stand.  This runs the fused native stack (BLS votes +
+real-BLS embedded DKG + era restart, flush_every=0) at BENCH_NODES and
+prints one JSON line with the wall time and, under BENCH_PROFILE=1, the
+cProfile share of the pairing plane (miller loop + final exp) vs the
+DKG/group algebra (jac_mul + poly/commitment evaluation).  cProfile
+inflates Python-frame-heavy code ~3x (CLAUDE.md round-2 lesson), so the
+SHARES are the signal, never the absolute seconds.
+
+    BENCH_NODES=16 BENCH_PROFILE=1 python benchmarks/bls_era_change.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.crypto.bls import BLSSuite
+from hbbft_tpu.protocols.dynamic_honey_badger import Change
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+
+def run_era_change(n: int) -> dict:
+    nat = native_engine.NativeQhbNet(
+        n, seed=2, batch_size=max(8, n), num_faulty=0, session_id=b"bls-era",
+        suite=BLSSuite(), flush_every=0,
+    )
+    keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+    keep.pop(n - 1)
+    for nid in range(n):
+        nat.send_input(nid, Input.change(Change.node_change(keep)))
+
+    def done(e):
+        return all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        )
+
+    t0 = time.perf_counter()
+    rounds = 0
+    for r in range(16):
+        if done(nat):
+            break
+        rounds = r + 1
+        for nid in range(n):
+            nat.send_input(nid, Input.user(f"e{r}-{nid}"))
+        want = len(nat.nodes[0].outputs) + 1
+        nat.run_until(
+            lambda e, w=want: all(
+                len(e.nodes[i].outputs) >= w for i in e.correct_ids
+            ),
+            chunk=2000,
+        )
+    wall = time.perf_counter() - t0
+    assert done(nat), "era change did not complete"
+    new_pks = {
+        nat.nodes[i].qhb.dhb.netinfo.public_key_set.to_bytes()
+        for i in nat.correct_ids
+    }
+    assert len(new_pks) == 1, "nodes derived different master keys"
+    out = {
+        "config": "bls_native_era_change",
+        "nodes": n,
+        "era_change_wall_s": round(wall, 1),
+        "epochs": rounds,
+        "delivered_msgs": nat.delivered,
+        "flush_stats": dict(nat.flush_stats),
+    }
+    nat.close()
+    return out
+
+
+# tottime buckets by source file (os.path basename under hbbft_tpu/):
+# the pairing plane is the Batched backend's RLC verification math; the
+# DKG algebra is the group/poly arithmetic SyncKeyGen drives; serde and
+# the KEM are the other two named suspects from rounds 3-4.
+_BUCKETS = {
+    "pairing_plane": ("crypto/bls/pairing.py", "crypto/bls/fields.py"),
+    "dkg_group_algebra": (
+        "crypto/bls/curve.py", "crypto/poly.py",
+        "protocols/sync_key_gen.py",
+    ),
+    "kem_keys": ("crypto/keys.py", "crypto/bls/suite.py"),
+    "serde": ("utils/serde.py",),
+}
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_NODES", "16"))
+    if os.environ.get("BENCH_PROFILE"):
+        import cProfile
+        import pstats
+
+        prof = cProfile.Profile()
+        prof.enable()
+        out = run_era_change(n)
+        prof.disable()
+        stats = pstats.Stats(prof)
+        total = 0.0
+        buckets = {k: 0.0 for k in _BUCKETS}
+        rows = []
+        for (fname, _line, func), (cc, nc, tt, ct, callers) in stats.stats.items():
+            total += tt
+            norm = fname.replace("\\", "/")
+            for bucket, paths in _BUCKETS.items():
+                if any(norm.endswith(p) for p in paths):
+                    buckets[bucket] += tt
+                    break
+            if tt > 0.5:
+                rows.append((round(tt, 2), os.path.basename(fname), func))
+        rows.sort(reverse=True)
+        out["profile"] = {
+            "tottime_total_s": round(total, 1),
+            "shares": {
+                k: round(v / total, 3) if total else 0
+                for k, v in buckets.items()
+            },
+            "seconds": {k: round(v, 1) for k, v in buckets.items()},
+            "top": rows[:20],
+            "note": "cProfile shares, not absolutes (CLAUDE.md ~3x inflation)",
+        }
+    else:
+        out = run_era_change(n)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

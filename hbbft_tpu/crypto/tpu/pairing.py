@@ -301,11 +301,15 @@ def g2_affine(p: dcurve.Point) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return fq2.mul(x, zi2), fq2.mul(y, fq2.mul(zi2, zi))
 
 
-def pairing_product_is_one(g1s: dcurve.Point, g2s: dcurve.Point) -> jnp.ndarray:
-    """prod_i e(P_i, Q_i) == 1 over a batch axis; one final exponentiation.
+def miller_product(g1s: dcurve.Point, g2s: dcurve.Point) -> jnp.ndarray:
+    """prod_i f_{x,Q_i}(P_i) over the batch axis — the pairing product
+    BEFORE the final exponentiation (one Fq12 element).
 
     Pairs where either side is the identity contribute the factor 1
-    (mirrors the oracle's multi_pairing_is_one None-skip).
+    (mirrors the oracle's multi_pairing_is_one None-skip).  Splitting
+    this from :func:`final_exp_is_one` lets a caller combine several
+    independently-computed Miller products and pay ONE final
+    exponentiation for all of them (the TpuBackend cross-chunk flush).
     """
     px, py = g1_affine(g1s)
     qx, qy = g2_affine(g2s)
@@ -316,4 +320,9 @@ def pairing_product_is_one(g1s: dcurve.Point, g2s: dcurve.Point) -> jnp.ndarray:
     acc = fs[0]
     for i in range(1, fs.shape[0]):
         acc = mul(acc, fs[i])
-    return final_exp_is_one(acc)
+    return acc
+
+
+def pairing_product_is_one(g1s: dcurve.Point, g2s: dcurve.Point) -> jnp.ndarray:
+    """prod_i e(P_i, Q_i) == 1 over a batch axis; one final exponentiation."""
+    return final_exp_is_one(miller_product(g1s, g2s))

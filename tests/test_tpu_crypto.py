@@ -416,6 +416,47 @@ def test_device_endo_subgroup_matches_oracle():
             )
 
 
+@heavy_compile
+def test_tpu_backend_multi_chunk_combined():
+    """The round-5 cross-chunk path: CHUNK=8 over 24 same-message
+    sig-share requests -> 3 chunks, whose pairs combine into ONE batched
+    Miller loop + final exponentiation.  A bad share in chunk 1 makes
+    the combined verdict False, exercising the per-chunk recheck +
+    bisection fallback; verdicts must match the host RLC backend
+    (CLAUDE.md: every device-path change needs an oracle cross-check).
+
+    Shapes deliberately mirror the round-5 validation drive (scan bucket
+    16/16/2, pair buckets 9 and 3) so a warm cache reuses its entries.
+    """
+    suite = BLSSuite()
+    rngpy = random.Random(99)
+    sks = SecretKeySet.random(2, rngpy, suite)
+    pks = sks.public_keys()
+    msg = b"two-stage flush doc"
+    reqs = [
+        VerifyRequest.sig_share(
+            pks.public_key_share(i % 8), msg,
+            sks.secret_key_share(i % 8).sign(msg),
+        )
+        for i in range(24)
+    ]
+    reqs[13] = VerifyRequest.sig_share(
+        pks.public_key_share(5), msg, sks.secret_key_share(4).sign(msg)
+    )  # bad share in the middle chunk
+    want = BatchedBackend(suite).verify_batch(reqs)
+    be = TpuBackend(suite)
+    be.CHUNK = 8
+    got = be.verify_batch(reqs)
+    assert got == want
+    assert got[13] is False and sum(got) == 23
+
+    # All-good: the combined fast path must short-circuit to all True.
+    reqs[13] = VerifyRequest.sig_share(
+        pks.public_key_share(5), msg, sks.secret_key_share(5).sign(msg)
+    )
+    assert be.verify_batch(reqs) == [True] * 24
+
+
 def test_hybrid_backend_routing():
     """HybridBackend: device for big flushes, host for small, host-only
     when no accelerator is present (routing logic is platform-free)."""
