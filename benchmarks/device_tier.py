@@ -47,6 +47,15 @@ def main() -> None:
     t_all = time.monotonic()
     for name in MID_TESTS:
         t0 = time.monotonic()
+        # Strip the smoke-tier gate from the child env: all three tests
+        # are @heavy_compile, so an inherited HBBFT_TPU_CRYPTO_SMOKE=1
+        # (the documented quick-loop setting) would make every child
+        # skip-and-exit-0 — a false green from the very tool meant to
+        # catch kernel regressions.  A "skipped" summary is a failure.
+        child_env = {
+            k: v for k, v in os.environ.items()
+            if k != "HBBFT_TPU_CRYPTO_SMOKE"
+        }
         try:
             proc = subprocess.run(
                 [
@@ -57,6 +66,7 @@ def main() -> None:
                 cwd=ROOT,
                 capture_output=True,
                 text=True,
+                env=child_env,
                 timeout=int(
                     os.environ.get("DEVICE_TIER_STEP_TIMEOUT_S", "1800")
                 ),
@@ -64,6 +74,9 @@ def main() -> None:
             rc = proc.returncode
             tail = (proc.stdout or "").strip().splitlines()
             summary = tail[-1] if tail else ""
+            if rc == 0 and ("skipped" in summary or "1 passed" not in summary):
+                rc = 1
+                summary = f"did not pass exactly one test: {summary}"
         except subprocess.TimeoutExpired:
             # A cold cache shows up as a compile stall blowing the step
             # timeout — that must be RECORDED in the artifact (it is the

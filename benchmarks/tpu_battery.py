@@ -99,7 +99,7 @@ def run_step(name: str, argv: list[str], env: dict, timeout_s: float, sink) -> N
 
 
 def main() -> None:
-    tag = os.environ.get("BATTERY_TAG", "r04")
+    tag = os.environ.get("BATTERY_TAG", "r05")
     out_path = os.path.join(ROOT, f"BATTERY_{tag}.jsonl")
     ok, note = probe_tpu()
     with open(out_path, "a") as sink:
@@ -136,6 +136,13 @@ def main() -> None:
         run_step(
             "bench_flush_headline", [py, "bench.py"],
             {"BENCH_DEADLINE_S": "2400"}, 2700, sink,
+        )
+        run_step(
+            "flush_roofline_2048", [py, "benchmarks/flush_roofline.py"],
+            # Warm by construction: runs after the 2048 bench step
+            # compiled its buckets.  Stage walls + cost_analysis are the
+            # round-5 roofline record (VERDICT #1).
+            {"ROOFLINE_SHARES": "2048"}, 2700, sink,
         )
         run_step(
             "config5_firehose", [py, "benchmarks/config5_firehose.py"],

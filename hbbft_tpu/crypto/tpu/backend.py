@@ -252,6 +252,14 @@ class TpuBackend(CryptoBackend):
         """Dispatch one chunk's SCAN kernel; returns (sub_ok, lhs, rhs)
         device values WITHOUT forcing a host sync, so independent chunks
         pipeline on device."""
+        (n1, n2, nl), args = self._scan_prep(reqs)
+        return _scan_kernel(n1, n2, nl)(*args)
+
+    def _scan_prep(self, reqs: Sequence[VerifyRequest]):
+        """Host prep for one chunk: returns ((n1, n2, nl), kernel args).
+        Split from :meth:`_scan_dev` so measurement tooling
+        (benchmarks/flush_roofline.py) can lower the cached kernel on
+        the exact production inputs."""
         coeffs = _batch_coefficients(self.suite, reqs)
         g2e, g1e, rhs = self._build_legs(reqs, coeffs)
         n1 = _bucket(max(len(g1e), 1))
@@ -318,9 +326,9 @@ class TpuBackend(CryptoBackend):
             seg = put(seg, seg_sh)
             rhs_pts = tuple(put(c, repl) for c in rhs_pts)
             gen_pt = tuple(put(c, repl) for c in gen_pt)
-        return _scan_kernel(n1, n2, nl)(
+        return (n1, n2, nl), (
             g1_pts, g1_bits, g1_chk, seg,
-            g2_pts, g2_bits_s, g2_bits_q, g2_chk, rhs_pts, gen_pt
+            g2_pts, g2_bits_s, g2_bits_q, g2_chk, rhs_pts, gen_pt,
         )
 
     def _check_parts(self, parts) -> Any:

@@ -605,7 +605,7 @@ inline std::vector<int> str_sorted(std::vector<int> ids) {
 // ===========================================================================
 
 struct Sbv {
-  int n, f;
+  int n = 0, f = 0;
   NodeSet bval_received[2], aux_received[2];
   NodeSet termed_bval[2], termed_aux[2];
   bool bval_sent[2] = {false, false};
@@ -613,6 +613,7 @@ struct Sbv {
   uint8_t bin_values = 0;  // BoolSet mask: 1 = False present, 2 = True
   int last_output = -1;    // -1 = none yet, else BoolSet mask
 
+  Sbv() = default;
   Sbv(int n_, int f_) : n(n_), f(f_) {}
 };
 
@@ -658,8 +659,8 @@ struct Td {
 // ===========================================================================
 
 struct Bcast {
-  int proposer;
-  int data_shards;
+  int proposer = -1;
+  int data_shards = 0;
   // echos / echo_hashes / readys / can_decode, with insertion order where
   // Python iterates dict insertion order (readys for Counter()).
   std::map<int, std::shared_ptr<const ProofData>> echos;
@@ -704,7 +705,15 @@ const int MAX_FUTURE_ROUNDS = 100;
 struct Ba {
   Bytes session_id;
   int round = 0;
-  std::unique_ptr<Sbv> sbv;
+  // Round-5 arena note: Sbv lives INLINE (value member) and Proposal
+  // holds Bcast/Ba inline below, so one epoch's per-proposer protocol
+  // state is a single contiguous proposals array instead of ~4 heap
+  // objects per proposer — the COIN/DECRYPT delivery envelope was
+  // measured mostly cache misses chasing that pointer web (BASELINE.md
+  // round 4).  Ts/Td stay shared_ptr: they escape into Pending, whose
+  // continuations can outlive the epoch (commit_events may destroy the
+  // EpochState mid-drain).
+  Sbv sbv;
   bool conf_sent = false;
   std::vector<std::pair<int, uint8_t>> confs;  // (sender, BoolSet) insertion order
   NodeSet confs_set;
@@ -727,11 +736,55 @@ struct Ba {
 // ===========================================================================
 
 struct Proposal {
-  std::unique_ptr<Bcast> bc;
-  std::unique_ptr<Ba> ba;
+  Bcast bc;
+  Ba ba;
   BytesP value;
   int decision = -1;  // -1 undecided
   bool emitted = false;
+
+  // Reset-in-place for epoch-state reuse (round 5): the whole
+  // per-epoch protocol state is recycled instead of reallocated, so
+  // the proposals array (and its inner container capacities where the
+  // container keeps them) stays resident — the delivery envelope at
+  // big N is dominated by dependent cache misses chasing freshly
+  // allocated state (BASELINE.md round-4/5 profiles).  EVERY field of
+  // Bcast/Ba/Sbv/Proposal must be restored here; a missed field is
+  // cross-epoch contamination (the native equivalence suites pin this
+  // byte-for-byte against the Python net).
+  void reset() {
+    bc.echos.clear();
+    bc.echo_hashes.clear();
+    bc.readys.clear();
+    bc.ready_root_order.clear();
+    bc.can_decode.clear();
+    bc.echo_full_by_root.clear();
+    bc.echo_any_by_root.clear();
+    bc.ready_by_root.clear();
+    bc.can_decode_sent = bc.echo_sent = bc.ready_sent = false;
+    bc.had_input = bc.terminated = false;
+    bc.value = nullptr;
+    ba.round = 0;
+    ba.sbv = Sbv();
+    ba.conf_sent = false;
+    ba.confs.clear();
+    ba.confs_set = NodeSet();
+    ba.term_confs = NodeSet();
+    ba.coin = nullptr;
+    ba.coin_requested = false;
+    ba.coin_value = -1;
+    ba.conf_vals = -1;
+    ba.estimate = -1;
+    ba.terms[0] = NodeSet();
+    ba.terms[1] = NodeSet();
+    ba.term_senders = NodeSet();
+    ba.future.clear();
+    ba.future_count.clear();
+    ba.decision = -1;
+    ba.terminated = false;
+    value = nullptr;
+    decision = -1;
+    emitted = false;
+  }
 };
 
 // A Subset output awaiting the honey-badger boundary (Python: outputs
@@ -743,8 +796,8 @@ struct SubsetOutItem {
 };
 
 struct EpochState {
-  int epoch;
-  bool encrypted;
+  int epoch = 0;
+  bool encrypted = false;
   Bytes subset_session;
   std::vector<Proposal> proposals;  // indexed by proposer id
   bool subset_done = false;
@@ -759,6 +812,21 @@ struct EpochState {
   bool batch_emitted = false;
   std::vector<SubsetOutItem> pending_outputs;
   std::vector<std::pair<int, BytesP>> pending_payloads;  // all_at_end buffer
+
+  // Epoch-advance reset (see Proposal::reset): same fresh-state
+  // semantics as reallocating, but the object and its proposals array
+  // stay in place.
+  void reset_for_epoch() {
+    subset_done = done_emitted = subset_terminated = false;
+    decrypts.clear();
+    accepted_order.clear();
+    plaintexts.clear();
+    decrypted = NodeSet();
+    faulty_proposers = NodeSet();
+    proposed = batch_emitted = false;
+    pending_outputs.clear();
+    pending_payloads.clear();
+  }
 };
 
 struct BatchData {
@@ -777,7 +845,11 @@ struct Hb {
   int sched_n = 1;
   // SubsetHandlingStrategy: 0 incremental, 1 all_at_end
   int subset_handling = 0;
-  std::unique_ptr<EpochState> state;
+  // INLINE and recycled (round 5): Node.hb and Hb.state used to be two
+  // heap hops in front of every delivery's state access — two dependent
+  // cache misses per message at big N, the measured bulk of the
+  // COIN-continuation envelope.
+  EpochState state;
   std::map<int, std::vector<std::pair<int, EMsg>>> future;  // epoch -> msgs
   std::map<int, int> future_per_sender;
 
@@ -846,8 +918,11 @@ struct Node {
   std::vector<int> val_index;
   int era_n = 0, era_f = 0;
   int era = 0;
-  std::unique_ptr<Hb> hb;
+  Hb hb;                // inline (see Hb.state note); valid iff hb_init
+  bool hb_init = false;
   std::vector<Pending> pool;
+  std::vector<Pending> flush_scratch;  // engine_flush_pool drain buffer
+  bool flushing = false;               // reentrancy guard for the scratch
   std::vector<Fault> faults;
   std::vector<std::pair<int, EMsg>> next_era_buffer;
   std::vector<BatchData> pending_batches;
@@ -1285,10 +1360,11 @@ struct Ctx {
   void ts_verified_cb(int era, int epoch, int proposer, int rnd,
                       std::shared_ptr<Ts> ts, int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
-    bool live_epoch = node.era == era && node.hb && node.hb->epoch == epoch;
+    bool live_epoch = node.era == era && node.hb_init && node.hb.epoch == epoch;
     if (!live_epoch) e.suppress_emit++;
     std::vector<uint8_t> parity_out;
     // inner: TS._on_verified
+    uint64_t t12 = prof_tick();
     if (!ts->terminated) {
       if (!ok) {
         ops.fault(sender, F_TS_INVALID);
@@ -1301,19 +1377,24 @@ struct Ctx {
         ts_try_output(*ts, parity_out);
       }
     }
+    e.prof_cycles[12] += prof_tick() - t12;
+    e.prof_count[12]++;
     // lift: coin scope (round / BA termination / same instance), then the
     // subset-output and epoch-advance boundaries (_on_ba_step ->
     // _guard_epoch(_on_subset_step) -> _advance in the Python chain).
     if (live_epoch) {
-      EpochState& st = *node.hb->state;
+      uint64_t t15 = prof_tick();
+      EpochState& st = node.hb.state;
       if (!parity_out.empty()) {
-        Ba& ba = *st.proposals[proposer].ba;
+        Ba& ba = st.proposals[proposer].ba;
         if (ba.round == rnd && !ba.terminated && ba.coin == ts) {
           for (uint8_t par : parity_out) ba_on_coin(st, proposer, ba, par);
         }
       }
       hb_drain_subset_outputs(st);
       hb_advance();
+      e.prof_cycles[15] += prof_tick() - t15;
+      e.prof_count[15]++;
     }
     if (!live_epoch) e.suppress_emit--;
   }
@@ -1485,9 +1566,9 @@ struct Ctx {
       m.proposer = proposer;
       m.round = ba.round;
       m.type = BA_CONF;
-      m.bval = ba.sbv->bin_values;
+      m.bval = ba.sbv.bin_values;
       ops.broadcast(m);
-      ba_handle_conf(st, proposer, ba, node.id, ba.sbv->bin_values);
+      ba_handle_conf(st, proposer, ba, node.id, ba.sbv.bin_values);
     } else {
       ba_try_start_coin(st, proposer, ba);
     }
@@ -1506,7 +1587,7 @@ struct Ctx {
 
   void ba_try_start_coin(EpochState& st, int proposer, Ba& ba) {
     if (ba.coin_requested || !ba.conf_sent) return;
-    uint8_t bin = ba.sbv->bin_values;
+    uint8_t bin = ba.sbv.bin_values;
     int accepted_count = 0;
     uint8_t acc_union = 0;
     for (auto& kv : ba.confs) {
@@ -1550,7 +1631,7 @@ struct Ctx {
 
   void ba_next_round(EpochState& st, int proposer, Ba& ba) {
     ba.round += 1;
-    ba.sbv = std::make_unique<Sbv>(n(), f());
+    ba.sbv = Sbv(n(), f());
     ba.conf_sent = false;
     ba.confs.clear();
     ba.confs_set = NodeSet();
@@ -1565,7 +1646,7 @@ struct Ctx {
       // small-int set iteration note in the engine tests).
       for (int sender = 0; sender < n(); ++sender) {
         if (!ba.terms[b].has(sender)) continue;
-        sbv_add_term_evidence(st, proposer, ba.round, *ba.sbv, sender, b, outs);
+        sbv_add_term_evidence(st, proposer, ba.round, ba.sbv, sender, b, outs);
         ba_consume_sbv(st, proposer, ba, outs);
         // Python: confs.setdefault(sender, single(b)); term_confs.add
         // (unconditional) — no conf-threshold re-check here.
@@ -1576,7 +1657,7 @@ struct Ctx {
         ba.term_confs.add(sender);
       }
     }
-    sbv_input(st, proposer, ba.round, *ba.sbv, ba.estimate == 1, outs);
+    sbv_input(st, proposer, ba.round, ba.sbv, ba.estimate == 1, outs);
     ba_consume_sbv(st, proposer, ba, outs);
     // Replay buffered future-round messages.
     std::vector<std::pair<int, EMsg>> future;
@@ -1599,7 +1680,7 @@ struct Ctx {
         return;
       }
       std::vector<uint8_t> outs;
-      sbv_add_term_evidence(st, proposer, ba.round, *ba.sbv, sender, b, outs);
+      sbv_add_term_evidence(st, proposer, ba.round, ba.sbv, sender, b, outs);
       ba_consume_sbv(st, proposer, ba, outs);
       if (!ba.confs_set.has(sender)) {
         ba.term_confs.add(sender);
@@ -1627,7 +1708,7 @@ struct Ctx {
     if (ba.estimate >= 0 || ba.terminated) return;
     ba.estimate = input ? 1 : 0;
     std::vector<uint8_t> outs;
-    sbv_input(st, proposer, ba.round, *ba.sbv, input, outs);
+    sbv_input(st, proposer, ba.round, ba.sbv, input, outs);
     ba_consume_sbv(st, proposer, ba, outs);
   }
 
@@ -1655,12 +1736,12 @@ struct Ctx {
     std::vector<uint8_t> outs;
     switch (m.type) {
       case BA_BVAL:
-        sbv_handle_bval(st, proposer, m.round, *ba.sbv, sender, m.bval != 0,
+        sbv_handle_bval(st, proposer, m.round, ba.sbv, sender, m.bval != 0,
                         outs);
         ba_consume_sbv(st, proposer, ba, outs);
         break;
       case BA_AUX:
-        sbv_handle_aux(st, proposer, m.round, *ba.sbv, sender, m.bval != 0,
+        sbv_handle_aux(st, proposer, m.round, ba.sbv, sender, m.bval != 0,
                        outs);
         ba_consume_sbv(st, proposer, ba, outs);
         break;
@@ -1690,7 +1771,7 @@ struct Ctx {
 
   void subset_input(EpochState& st, const BytesP& payload) {
     if (st.subset_terminated) return;
-    bc_input(st, node.id, *st.proposals[node.id].bc, payload);
+    bc_input(st, node.id, st.proposals[node.id].bc, payload);
   }
 
   void subset_handle_message(EpochState& st, int sender, const EMsg& m) {
@@ -1706,10 +1787,10 @@ struct Ctx {
       case BC_READY:
       case BC_ECHO_HASH:
       case BC_CAN_DECODE:
-        bc_handle_message(st, m.proposer, *prop.bc, sender, m);
+        bc_handle_message(st, m.proposer, prop.bc, sender, m);
         break;
       default:
-        ba_handle_message(st, m.proposer, *prop.ba, sender, m);
+        ba_handle_message(st, m.proposer, prop.ba, sender, m);
         break;
     }
   }
@@ -1719,7 +1800,7 @@ struct Ctx {
     Proposal& prop = st.proposals[proposer];
     if (!prop.value) {
       prop.value = value;
-      ba_input(st, proposer, *prop.ba, true);
+      ba_input(st, proposer, prop.ba, true);
     }
     subset_progress(st, proposer);
   }
@@ -1744,7 +1825,7 @@ struct Ctx {
     if (accepted < num_correct()) return;
     for (int pid : node.val_ids) {  // insertion order == sorted all_ids
       Proposal& p = st.proposals[pid];
-      if (p.decision < 0 && !p.ba->terminated) ba_input(st, pid, *p.ba, false);
+      if (p.decision < 0 && !p.ba.terminated) ba_input(st, pid, p.ba, false);
     }
   }
 
@@ -2171,7 +2252,7 @@ struct Ctx {
 
   void td_ct_checked_cb(int era, int epoch, int proposer,
                         std::shared_ptr<Td> td, bool ok) {
-    bool live = node.era == era && node.hb && node.hb->epoch == epoch;
+    bool live = node.era == era && node.hb_init && node.hb.epoch == epoch;
     if (!live) e.suppress_emit++;
     std::vector<BytesP> plain_out;
     // inner: ThresholdDecrypt._on_ciphertext_checked
@@ -2263,7 +2344,7 @@ struct Ctx {
   void td_verified_cb(int era, int epoch, int proposer, std::shared_ptr<Td> td,
                       int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
-    bool live = node.era == era && node.hb && node.hb->epoch == epoch;
+    bool live = node.era == era && node.hb_init && node.hb.epoch == epoch;
     if (!live) e.suppress_emit++;
     std::vector<BytesP> plain_out;
     if (!td->terminated) {  // Python: terminated check BEFORE the ok check
@@ -2398,7 +2479,7 @@ struct Ctx {
   // (era, epoch) is live (the _guard_epoch wrap).
   void hb_on_decrypt_boundary(int proposer, std::shared_ptr<Td> td,
                               std::vector<BytesP>& plain_out) {
-    EpochState& st = *node.hb->state;
+    EpochState& st = node.hb.state;
     if (td->ciphertext_invalid && !st.faulty_proposers.has(proposer)) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CT);
@@ -2454,7 +2535,7 @@ struct Ctx {
         hb_try_batch(st);
       } else {
         st.accepted_order.push_back(out.proposer);
-        if (node.hb->subset_handling == 1) {
+        if (node.hb.subset_handling == 1) {
           st.pending_payloads.push_back({out.proposer, out.value});
         } else {
           hb_start_decrypt(st, out.proposer, out.value);
@@ -2500,30 +2581,33 @@ struct Ctx {
     // ciphertext_invalid not yet known — verification is deferred).
   }
 
-  std::unique_ptr<EpochState> hb_make_state(int epoch) {
-    auto st = std::make_unique<EpochState>();
-    st->epoch = epoch;
-    st->encrypted = node.hb->encrypt_on(epoch);
+  // Reset-in-place successor of the round-2..4 hb_make_state (which
+  // heap-allocated a fresh EpochState per epoch): the same object and
+  // its proposals array are recycled — fresh-state semantics come from
+  // the exhaustive per-field resets (EpochState::reset_for_epoch +
+  // Proposal::reset), pinned by the native equivalence suites.
+  void hb_reset_state(EpochState& st, int epoch) {
+    st.reset_for_epoch();
+    st.epoch = epoch;
+    st.encrypted = node.hb.encrypt_on(epoch);
     Bytes ss;
-    canon_append(ss, node.hb->session_id);
+    canon_append(ss, node.hb.session_id);
     canon_append(ss, canon_int_bytes((uint64_t)epoch));
-    st->subset_session = ss;
-    st->proposals.resize(e.n);
+    st.subset_session = ss;
+    st.proposals.resize(e.n);
+    for (Proposal& p : st.proposals) p.reset();
     for (int pid : node.val_ids) {
-      Proposal& p = st->proposals[pid];
-      p.bc = std::make_unique<Bcast>();
-      p.bc->proposer = pid;
-      p.bc->data_shards = n() - 2 * f();
-      p.ba = std::make_unique<Ba>();
+      Proposal& p = st.proposals[pid];
+      p.bc.proposer = pid;
+      p.bc.data_shards = n() - 2 * f();
       Bytes bs;
       canon_append(bs, "subset-ba");
       canon_append(bs, ss);
       canon_append(bs, std::to_string(pid));
-      p.ba->session_id = bs;
-      p.ba->sbv = std::make_unique<Sbv>(n(), f());
-      Ctx::ba_make_coin_static(*p.ba);
+      p.ba.session_id = bs;
+      p.ba.sbv = Sbv(n(), f());
+      Ctx::ba_make_coin_static(p.ba);
     }
-    return st;
   }
 
   static void ba_make_coin_static(Ba& ba) {
@@ -2538,10 +2622,10 @@ struct Ctx {
   }
 
   void hb_advance() {
-    Hb& hb = *node.hb;
-    while (hb.state->batch_emitted) {
+    Hb& hb = node.hb;
+    while (hb.state.batch_emitted) {
       hb.epoch += 1;
-      hb.state = hb_make_state(hb.epoch);
+      hb_reset_state(hb.state, hb.epoch);
       auto it = hb.future.find(hb.epoch);
       std::vector<std::pair<int, EMsg>> replay;
       if (it != hb.future.end()) {
@@ -2562,7 +2646,7 @@ struct Ctx {
   }
 
   void hb_state_dispatch(int sender, const EMsg& m) {
-    EpochState& st = *node.hb->state;
+    EpochState& st = node.hb.state;
     if (m.type == HB_DECRYPT) {
       if (!st.encrypted) {
         ops.fault(sender, F_HB_BAD_CT);
@@ -2585,7 +2669,7 @@ struct Ctx {
   }
 
   void hb_handle_message(int sender, const EMsg& m) {
-    Hb& hb = *node.hb;
+    Hb& hb = node.hb;
     if (m.epoch < hb.epoch) return;
     if (m.epoch > hb.epoch + hb.max_future_epochs) {
       ops.fault(sender, F_HB_FUTURE);
@@ -2610,7 +2694,7 @@ struct Ctx {
   }
 
   void hb_propose(const Bytes& payload) {
-    EpochState& st = *node.hb->state;
+    EpochState& st = node.hb.state;
     if (st.proposed) return;
     st.proposed = true;
     subset_input(st, std::make_shared<const Bytes>(payload));
@@ -2671,8 +2755,19 @@ void pending_run(Engine& e, Node& node, Pending& p, bool ok) {
 }
 
 void engine_flush_pool(Engine& e, Node& node) {
+  // Scalar mode.  Same swap-rounds semantics as always (a nested flush
+  // — batch callback proposing into a nested engine_unit — sees only
+  // its own fresh entries), but the drain buffer is a PER-NODE scratch
+  // whose capacity survives across flushes: the round-2..4 form
+  // constructed and destructed a std::vector per flush, one alloc+free
+  // per share-carrying delivery — pure COIN-envelope overhead.  The
+  // nested case (node.flushing already set) takes a local vector so the
+  // outer frame's scratch is never clobbered.
+  bool outer = !node.flushing;
+  std::vector<Pending> local;
+  std::vector<Pending>& items = outer ? node.flush_scratch : local;
+  if (outer) node.flushing = true;
   while (!node.pool.empty()) {
-    std::vector<Pending> items;
     items.swap(node.pool);
     e.pool_items -= items.size();
     for (Pending& p : items) {
@@ -2690,7 +2785,9 @@ void engine_flush_pool(Engine& e, Node& node) {
       }
       if (dt > e.prof_cycles[11]) e.prof_cycles[11] = dt;
     }
+    items.clear();
   }
+  if (outer) node.flushing = false;
 }
 
 // External-crypto flush: mirrors VirtualNet._flush_all_pools — visit
@@ -2924,14 +3021,15 @@ void hbe_init_node(void* h, int32_t node, int32_t era, const uint8_t* session,
   nd.pk_shares.resize(e->n);
   for (int i = 0; i < e->n; ++i)
     nd.pk_shares[i] = u256_from_be(pk_shares + 32 * i, 32);
-  nd.hb = std::make_unique<Hb>();
-  nd.hb->session_id.assign((const char*)session, session_len);
-  nd.hb->max_future_epochs = max_future_epochs;
-  nd.hb->sched_kind = sched_kind;
-  nd.hb->sched_n = sched_n;
-  nd.hb->subset_handling = subset_handling;
+  nd.hb = Hb();
+  nd.hb_init = true;
+  nd.hb.session_id.assign((const char*)session, session_len);
+  nd.hb.max_future_epochs = max_future_epochs;
+  nd.hb.sched_kind = sched_kind;
+  nd.hb.sched_n = sched_n;
+  nd.hb.subset_handling = subset_handling;
   Ctx ctx(*e, nd);
-  nd.hb->state = ctx.hb_make_state(0);
+  ctx.hb_reset_state(nd.hb.state, 0);
 }
 
 // Era restart: re-init + replay the buffered next-era messages
@@ -2975,8 +3073,8 @@ int32_t hbe_propose(void* h, int32_t node, int32_t era, const uint8_t* payload,
                     uint64_t len) {
   Engine* e = (Engine*)h;
   Node& nd = e->nodes[node];
-  if (nd.silent || nd.era != era || !nd.hb) return 0;
-  if (nd.hb->state->proposed) return 0;
+  if (nd.silent || nd.era != era || !nd.hb_init) return 0;
+  if (nd.hb.state.proposed) return 0;
   Bytes data((const char*)payload, len);
   if (e->depth > 0) {
     Ctx ctx(*e, nd);
@@ -3000,12 +3098,12 @@ uint64_t hbe_queue_len(void* h) { return ((Engine*)h)->queue.size(); }
 uint64_t hbe_delivered(void* h) { return ((Engine*)h)->delivered; }
 int32_t hbe_epoch(void* h, int32_t node) {
   Node& nd = ((Engine*)h)->nodes[node];
-  return nd.hb ? nd.hb->epoch : -1;
+  return nd.hb_init ? nd.hb.epoch : -1;
 }
 int32_t hbe_era(void* h, int32_t node) { return ((Engine*)h)->nodes[node].era; }
 int32_t hbe_has_proposed(void* h, int32_t node) {
   Node& nd = ((Engine*)h)->nodes[node];
-  return (nd.hb && nd.hb->state->proposed) ? 1 : 0;
+  return (nd.hb_init && nd.hb.state.proposed) ? 1 : 0;
 }
 
 // Current batch accessors (valid during a batch callback).
