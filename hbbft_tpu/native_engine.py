@@ -219,6 +219,31 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_kem_encrypt.argtypes = [
         u8p, u8p, ctypes.c_uint64, u8p, u8p, u8p, u8p,
     ]
+    # scalar DKG fast path (stateless registry; bytes args pass as
+    # c_char_p so Python bytes cross zero-copy)
+    cp = ctypes.c_char_p
+    lib.hbe_kem_encrypt_batch.restype = None
+    lib.hbe_kem_encrypt_batch.argtypes = [
+        cp, cp, ctypes.c_int32, cp, u8p, u8p, u8p,
+    ]
+    lib.hbe_dkg_register.restype = ctypes.c_int64
+    lib.hbe_dkg_register.argtypes = [cp, ctypes.c_int32, cp, cp]
+    lib.hbe_dkg_registry_size.restype = ctypes.c_uint64
+    lib.hbe_dkg_registry_size.argtypes = []
+    lib.hbe_dkg_clear.restype = None
+    lib.hbe_dkg_clear.argtypes = []
+    lib.hbe_dkg_ack_check.restype = ctypes.c_int32
+    lib.hbe_dkg_ack_check.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, cp, cp, cp, cp, u8p,
+    ]
+    lib.hbe_dkg_row_check.restype = ctypes.c_int32
+    lib.hbe_dkg_row_check.argtypes = [
+        ctypes.c_int64, ctypes.c_int32, cp, ctypes.c_int32,
+    ]
+    lib.hbe_dkg_row_evals.restype = None
+    lib.hbe_dkg_row_evals.argtypes = [
+        cp, ctypes.c_int32, ctypes.c_int32, u8p,
+    ]
     lib.hbe_flush.restype = None
     lib.hbe_flush.argtypes = [ctypes.c_void_p]
     lib.hbe_ret_bytes.restype = None

@@ -50,6 +50,139 @@ FAULT_ACK_BEFORE_PART = "sync_key_gen:ack-without-part"
 _SCALAR_BYTES = 32  # BLS12-381 r fits in 255 bits
 
 
+class _NativeDkg:
+    """Scalar-suite fast path for the DKG's N^3 private checks.
+
+    The committed-ack value check (KEM decrypt + commitment row eval +
+    compare) and the ack-row construction (poly evals + N encrypts) are
+    the measured Python tail of an era change (BASELINE.md round-4/5).
+    native/engine.cpp exposes them as single C calls over a registered
+    commitment matrix; semantics are byte-identical to the pure path
+    (same KEM, same Horner, same fault outcomes — the native engine
+    equivalence suites pin this end to end), and ANY mismatch in shape,
+    suite, or registry routing falls back to the pure-Python path.
+    """
+
+    def __init__(self, lib: Any, suite: Suite) -> None:
+        import ctypes
+
+        self._ctypes = ctypes
+        self._lib = lib
+        self._suite = suite
+        self._g = suite.g1_generator().to_bytes()
+        self._r = suite.scalar_modulus.to_bytes(_SCALAR_BYTES, "big")
+        from hbbft_tpu.crypto.keys import _scalar_kem
+
+        self.kem = _scalar_kem(suite)
+
+    def commit_id(self, commitment: Any) -> int:
+        """Register (once, memoized on the shared decoded object)."""
+        cached = commitment.__dict__.get("_native_cid")
+        if cached is not None:
+            return cached
+        try:
+            flat = b"".join(
+                e.value.to_bytes(_SCALAR_BYTES, "big")
+                for row in commitment.elems
+                for e in row
+            )
+            cid = int(
+                self._lib.hbe_dkg_register(
+                    flat, len(commitment.elems), self._g, self._r
+                )
+            )
+        except Exception:
+            cid = -1
+        object.__setattr__(commitment, "_native_cid", cid)
+        return cid
+
+    def ack_check(
+        self, cid: int, sender_pos: int, our_pos: int, ct: Any, sk_x: int
+    ) -> Tuple[int, int]:
+        """(rc, value): rc 1 ok, 2 bad value, 0 bad ciphertext, -1 fall
+        back."""
+        out = (self._ctypes.c_uint8 * _SCALAR_BYTES)()
+        rc = int(
+            self._lib.hbe_dkg_ack_check(
+                cid, sender_pos, our_pos,
+                ct.u.value.to_bytes(_SCALAR_BYTES, "big"), ct.v,
+                ct.w.value.to_bytes(_SCALAR_BYTES, "big"),
+                sk_x.to_bytes(_SCALAR_BYTES, "big"), out,
+            )
+        )
+        return rc, int.from_bytes(bytes(out), "big")
+
+    def row_check(self, cid: int, our_pos: int, plain: bytes, n1: int) -> int:
+        return int(self._lib.hbe_dkg_row_check(cid, our_pos, plain, n1))
+
+    def ack_values(
+        self, row: "Poly", pub_keys_g1: list, rng: Any
+    ) -> Tuple["Ciphertext", ...]:
+        """The ack's encrypted row evaluations, batched: one C call for
+        the N poly evals and one for the N KEM encrypts.  The rng draws
+        happen HERE in the exact per-encrypt order of the pure path
+        (PublicKey.encrypt draws randrange(1, r) once per call), so the
+        consumption stream — and every equivalence test — is unchanged.
+        """
+        ctypes = self._ctypes
+        n = len(pub_keys_g1)
+        mod = self._suite.scalar_modulus
+        coeffs = b"".join(
+            c.to_bytes(_SCALAR_BYTES, "big") for c in row.coeffs
+        )
+        evals = (ctypes.c_uint8 * (_SCALAR_BYTES * n))()
+        self._lib.hbe_dkg_row_evals(coeffs, len(row.coeffs), n, evals)
+        rs = b"".join(
+            rng.randrange(1, mod).to_bytes(_SCALAR_BYTES, "big")
+            for _ in range(n)
+        )
+        pks = b"".join(
+            g.value.to_bytes(_SCALAR_BYTES, "big") for g in pub_keys_g1
+        )
+        out_u = (ctypes.c_uint8 * (_SCALAR_BYTES * n))()
+        out_v = (ctypes.c_uint8 * (_SCALAR_BYTES * n))()
+        out_w = (ctypes.c_uint8 * (_SCALAR_BYTES * n))()
+        self._lib.hbe_kem_encrypt_batch(
+            pks, bytes(evals), n, rs, out_u, out_v, out_w
+        )
+        g_type = type(self._suite.g1_generator())
+        u_b, v_b, w_b = bytes(out_u), bytes(out_v), bytes(out_w)
+        cts = []
+        for j in range(n):
+            s = slice(_SCALAR_BYTES * j, _SCALAR_BYTES * (j + 1))
+            ct = Ciphertext(
+                g_type(int.from_bytes(u_b[s], "big"), mod),
+                v_b[s],
+                g_type(int.from_bytes(w_b[s], "big"), mod),
+                self._suite,
+            )
+            object.__setattr__(ct, "_verify_ok", True)
+            cts.append(ct)
+        return tuple(cts)
+
+
+_NATIVE_DKG: dict = {}
+
+
+def _native_dkg(suite: Suite) -> Optional[_NativeDkg]:
+    if suite.name != "scalar-insecure":
+        return None
+    nd = _NATIVE_DKG.get(suite.name, False)
+    if nd is not False:
+        return nd
+    try:
+        from hbbft_tpu import native_engine
+
+        lib = native_engine.get_lib()
+        nd = _NativeDkg(lib, suite) if lib is not None else None
+        if nd is not None and nd.kem is None:
+            nd = None
+    except Exception:
+        nd = None
+    _NATIVE_DKG[suite.name] = nd
+    return nd
+
+
 def _encode_scalars(vals: Tuple[int, ...]) -> bytes:
     """Fixed-width canonical encoding — the decrypted plaintext is
     attacker-chosen, so no pickle here (arbitrary-object deserialization
@@ -222,6 +355,18 @@ class SyncKeyGen:
         if row is None:
             return PartOutcome(fault=FAULT_BAD_PART)
         # Our ack: hand every node j one evaluation of its row.
+        nd = _native_dkg(self.suite)
+        if nd is not None:
+            mod = self.suite.scalar_modulus
+            pks_g1 = [getattr(self.pub_keys[n], "g1", None) for n in self._ids]
+            if all(
+                isinstance(getattr(g, "value", None), int)
+                and 0 <= g.value < mod
+                for g in pks_g1
+            ):
+                return PartOutcome(
+                    ack=Ack(sender, nd.ack_values(row, pks_g1, rng))
+                )
         values = tuple(
             self.pub_keys[n].encrypt(
                 _encode_scalars((row.eval(j + 1),)), rng
@@ -253,6 +398,28 @@ class SyncKeyGen:
         our_idx = self.our_index
         if our_idx is None:
             return AckOutcome()
+        # Native fast path: decrypt + decode + commitment consistency in
+        # one C call (identical verdicts; _NativeDkg docstring).
+        nd = _native_dkg(self.suite)
+        ct = ack.values[our_idx]
+        if (
+            nd is not None
+            and nd.kem.ct_ok(ct)
+            and len(ct.v) == _SCALAR_BYTES
+        ):
+            cid = nd.commit_id(state.commitment)
+            if cid >= 0:
+                rc, nval = nd.ack_check(
+                    cid, sender_idx + 1, our_idx + 1, ct, self.secret_key.x
+                )
+                if rc >= 0:
+                    # Mirror SecretKey.decrypt's ciphertext-validity memo
+                    # (rc 0 = invalid ct; 1/2 = valid ct).
+                    object.__setattr__(ct, "_verify_ok", rc != 0)
+                    if rc != 1:
+                        return AckOutcome(fault=FAULT_BAD_ACK)
+                    state.values[sender_idx + 1] = nval
+                    return AckOutcome()
         val = self._decrypt_value(ack, our_idx)
         if val is not None:
             # Private consistency: v must equal p_d(sender+1, our+1); check
@@ -394,7 +561,18 @@ class SyncKeyGen:
         if coeffs is None:
             return None
         row = Poly(coeffs, self.suite.scalar_modulus)
-        # Validate the row against the public commitment.
+        # Validate the row against the public commitment (native fast
+        # path: per-coefficient g*c comparison against the registered
+        # commitment's row — same verdict as the to_bytes comparison).
+        nd = _native_dkg(self.suite)
+        if nd is not None:
+            cid = nd.commit_id(part.commitment)
+            if cid >= 0:
+                rc = nd.row_check(
+                    cid, our_idx + 1, data, self.threshold + 1
+                )
+                if rc >= 0:
+                    return row if rc == 1 else None
         committed = part.commitment.row(our_idx + 1)
         ours = row.commitment(self.suite)
         if committed.to_bytes() != ours.to_bytes():

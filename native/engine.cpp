@@ -32,6 +32,7 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -2905,6 +2906,71 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
   return processed;
 }
 
+// ---------------------------------------------------------------------------
+// Scalar-suite DKG fast path: registered BivarCommitments + per-ack checks
+// ---------------------------------------------------------------------------
+//
+// The era-change tail is the N^3 per-ack Python work (BASELINE.md round-4
+// profile: decrypt + commitment row eval + compare per committed Ack, at
+// every node).  A commitment matrix registers once per decoded Part
+// (network-wide, on the shared object) and each ack check is ONE C call.
+//
+// The registry is process-global, mutex-guarded (ctypes.CDLL drops the
+// GIL during foreign calls, so concurrent Python threads CAN race here),
+// and byte-capped: when stored matrices exceed DKG_REG_MAX_BYTES the
+// whole registry is cleared and the GENERATION bumps — cids encode
+// (generation << 32 | index), so stale cids (including ones memoized on
+// still-live Python commitment objects) never resolve to a different
+// entry; they miss and the caller falls back to the pure-Python path,
+// which is always correct.  This bounds memory across unbounded era
+// churn in a long-lived process.
+
+struct DkgCommit {
+  int n1 = 0;
+  U256 g;                                  // suite g1 generator value
+  std::vector<U256> elems;                 // n1*n1 row-major [i][j]
+  std::map<int, std::vector<U256>> rows;   // x -> committed row coeffs
+};
+
+const size_t DKG_REG_MAX_BYTES = 128u << 20;  // matrices only; rows ~2x
+
+struct DkgRegistry {
+  std::mutex mu;
+  std::vector<DkgCommit> entries;
+  uint64_t generation = 0;
+  size_t bytes = 0;
+};
+
+DkgRegistry& dkg_registry() {
+  static DkgRegistry reg;
+  return reg;
+}
+
+// Committed row poly for x: row_j(x) = sum_i elems[i][j] * x^i
+// (BivarCommitment.row's Horner, cached per (commitment, x) exactly like
+// the Python object memo).  Caller holds the registry mutex.
+const std::vector<U256>& dkg_row(DkgCommit& c, int x) {
+  auto it = c.rows.find(x);
+  if (it != c.rows.end()) return it->second;
+  U256 xs = {{(uint64_t)x, 0, 0, 0}};
+  std::vector<U256> out(c.n1);
+  for (int j = 0; j < c.n1; ++j) {
+    U256 acc = U256_ZERO;
+    for (int i = c.n1 - 1; i >= 0; --i)
+      acc = addmod(mulmod(acc, xs), c.elems[i * c.n1 + j]);
+    out[j] = acc;
+  }
+  return c.rows.emplace(x, std::move(out)).first->second;
+}
+
+// Caller holds the registry mutex.
+inline DkgCommit* dkg_get(DkgRegistry& reg, int64_t cid) {
+  if (cid < 0 || (uint64_t)(cid >> 32) != reg.generation) return nullptr;
+  size_t idx = (size_t)(cid & 0xFFFFFFFF);
+  if (idx >= reg.entries.size()) return nullptr;
+  return &reg.entries[idx];
+}
+
 }  // namespace
 
 // ===========================================================================
@@ -2969,6 +3035,135 @@ void hbe_kem_encrypt(const uint8_t* pk_be, const uint8_t* msg,
   ct.v.assign((const char*)out_v, msg_len);
   U256 h = ct_hash_scalar(ct);
   u256_to_be32(mulmod(h, r), out_w);
+}
+
+// Batched hbe_kem_encrypt: n fixed-32-byte messages to n public keys
+// with n caller-drawn randomness values (the DKG ack row: one encrypted
+// evaluation per node).  Layout: flat n*32-byte arrays throughout.
+void hbe_kem_encrypt_batch(const uint8_t* pks_be, const uint8_t* msgs,
+                           int32_t n, const uint8_t* rs_be, uint8_t* out_u,
+                           uint8_t* out_v, uint8_t* out_w) {
+  for (int32_t i = 0; i < n; ++i)
+    hbe_kem_encrypt(pks_be + 32 * i, msgs + 32 * i, 32, rs_be + 32 * i,
+                    out_u + 32 * i, out_v + 32 * i, out_w + 32 * i);
+}
+
+// --- scalar-suite DKG fast path (registry notes above the C ABI) -----------
+
+// Register a BivarCommitment matrix: elems_be = n1*n1 32-byte BE scalars
+// (row-major), g_be = the suite's g1 generator value, r_be = the scalar
+// modulus.  Returns a cid >= 0, or -1 when the modulus is not this
+// build's R_MOD or an element is out of range (caller falls back to the
+// Python path).
+int64_t hbe_dkg_register(const uint8_t* elems_be, int32_t n1,
+                         const uint8_t* g_be, const uint8_t* r_be) {
+  if (n1 < 1 || n1 > 4096) return -1;
+  if (!(u256_from_be(r_be, 32) == R_MOD)) return -1;
+  DkgCommit c;
+  c.n1 = n1;
+  c.g = u256_from_be(g_be, 32);
+  if (!(u256_cmp(c.g, R_MOD) < 0)) return -1;
+  c.elems.resize((size_t)n1 * n1);
+  for (size_t k = 0; k < c.elems.size(); ++k) {
+    c.elems[k] = u256_from_be(elems_be + 32 * k, 32);
+    if (!(u256_cmp(c.elems[k], R_MOD) < 0)) return -1;
+  }
+  DkgRegistry& reg = dkg_registry();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  size_t add = c.elems.size() * sizeof(U256);
+  if (reg.bytes + add > DKG_REG_MAX_BYTES) {
+    reg.entries.clear();
+    reg.bytes = 0;
+    reg.generation++;  // stale cids from before the clear never resolve
+  }
+  reg.bytes += add;
+  reg.entries.push_back(std::move(c));
+  return (int64_t)((reg.generation << 32) | (reg.entries.size() - 1));
+}
+
+uint64_t hbe_dkg_registry_size() {
+  DkgRegistry& reg = dkg_registry();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  return reg.entries.size();
+}
+
+// Clear everything and bump the generation (tests / explicit release;
+// stale cids fall back to the pure-Python path, never misresolve).
+void hbe_dkg_clear() {
+  DkgRegistry& reg = dkg_registry();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  reg.entries.clear();
+  reg.bytes = 0;
+  reg.generation++;
+}
+
+// Full private ack check (sync_key_gen.handle_ack's value path): KEM
+// decrypt of the 32-byte ack slot, scalar decode, and the commitment
+// consistency check  g*val == row(sender_pos).eval(our_pos).
+// Returns: 1 = valid (out_val32 = the 32-byte BE value), 2 = ciphertext
+// valid but value bad (decode/consistency failure -> fault), 0 = the
+// ciphertext itself failed the KEM validity check (-> fault; the caller
+// records the ct-validity memo distinctly from the value verdict),
+// -1 = unknown cid (caller must FALL BACK to the Python path, never
+// fault).
+int32_t hbe_dkg_ack_check(int64_t cid, int32_t sender_pos, int32_t our_pos,
+                          const uint8_t* u_be, const uint8_t* v32,
+                          const uint8_t* w_be, const uint8_t* sk_be,
+                          uint8_t* out_val32) {
+  DkgRegistry& reg = dkg_registry();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  DkgCommit* c = dkg_get(reg, cid);
+  if (!c) return -1;
+  uint8_t plain[32];
+  if (!hbe_kem_decrypt(u_be, v32, 32, w_be, sk_be, plain)) return 0;
+  U256 val = u256_from_be(plain, 32);
+  if (!(u256_cmp(val, R_MOD) < 0)) return 2;
+  const std::vector<U256>& row = dkg_row(*c, sender_pos);
+  U256 y = {{(uint64_t)our_pos, 0, 0, 0}};
+  U256 expected = U256_ZERO;
+  for (int j = c->n1 - 1; j >= 0; --j)
+    expected = addmod(mulmod(expected, y), row[j]);
+  if (!(mulmod(c->g, val) == expected)) return 2;
+  std::memcpy(out_val32, plain, 32);
+  return 1;
+}
+
+// Part row consistency (sync_key_gen._decrypt_row's commitment check):
+// plain = the decrypted row plaintext (n1 32-byte BE coefficients);
+// checks g*coeff_j == committed row(our_pos)[j] for every j.  Returns 1
+// ok, 0 mismatch/out-of-range (caller faults, exactly like the Python
+// to_bytes comparison), -1 unknown cid (caller falls back).
+int32_t hbe_dkg_row_check(int64_t cid, int32_t our_pos, const uint8_t* plain,
+                          int32_t n_coeffs) {
+  DkgRegistry& reg = dkg_registry();
+  std::lock_guard<std::mutex> lk(reg.mu);
+  DkgCommit* c = dkg_get(reg, cid);
+  if (!c) return -1;
+  if (n_coeffs != c->n1) return 0;
+  const std::vector<U256>& row = dkg_row(*c, our_pos);
+  for (int j = 0; j < c->n1; ++j) {
+    U256 v = u256_from_be(plain + 32 * j, 32);
+    if (!(u256_cmp(v, R_MOD) < 0)) return 0;
+    if (!(mulmod(c->g, v) == row[j])) return 0;
+  }
+  return 1;
+}
+
+// Row evaluations for ack building (Poly.eval at x = 1..n_points):
+// coeffs_be = n_coeffs 32-byte BE scalars (ascending degree), out =
+// n_points * 32 bytes.
+void hbe_dkg_row_evals(const uint8_t* coeffs_be, int32_t n_coeffs,
+                       int32_t n_points, uint8_t* out) {
+  std::vector<U256> cs(n_coeffs);
+  for (int32_t k = 0; k < n_coeffs; ++k)
+    cs[k] = u256_from_be(coeffs_be + 32 * k, 32);
+  for (int32_t p = 0; p < n_points; ++p) {
+    U256 x = {{(uint64_t)(p + 1), 0, 0, 0}};
+    U256 acc = U256_ZERO;
+    for (int32_t k = n_coeffs - 1; k >= 0; --k)
+      acc = addmod(mulmod(acc, x), cs[k]);
+    u256_to_be32(acc, out + 32 * p);
+  }
 }
 
 void* hbe_create(int32_t n, int32_t f) {

@@ -265,3 +265,105 @@ def test_not_ready_generate_raises():
     assert not skg.is_ready()
     with pytest.raises(RuntimeError):
         skg.generate()
+
+
+def test_native_dkg_fast_path_matches_pure_python(monkeypatch):
+    """The scalar-suite native fast path (registered commitments,
+    one-call ack checks, batched ack building) must be BYTE-identical to
+    the pure-Python path: same Acks (same rng stream!), same values,
+    same fault outcomes.  The engine-vs-Python equivalence suites cannot
+    catch a native bug here because BOTH nets share this module — this
+    is the direct cross-check (CLAUDE.md oracle invariant).
+    """
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    nd = skg_mod._native_dkg(SUITE)
+    if nd is None:
+        pytest.skip("native engine unavailable")
+
+    def run(native: bool):
+        if native:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: nd})
+        else:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: None})
+        n, t = 5, 1
+        rng, sks, pks = _setup(n, seed=23)
+        nodes, parts = {}, {}
+        for i in range(n):
+            skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+            nodes[i] = skg
+            parts[i] = part
+        transcripts = []
+        acks = []
+        for dealer in sorted(parts):
+            part = parts[dealer]
+            for i in range(n):
+                out = nodes[i].handle_part(dealer, part, rng)
+                transcripts.append((i, dealer, out.fault))
+                if out.ack is not None:
+                    acks.append((i, out.ack))
+                    for ct in out.ack.values:
+                        transcripts.append(
+                            (ct.u.value, ct.v, ct.w.value)
+                        )
+        # one tampered ack value (valid ciphertext, wrong plaintext) and
+        # one corrupted ciphertext exercise the fault paths
+        from hbbft_tpu.crypto.keys import Ciphertext
+
+        s0, a0 = acks[0]
+        bad_vals = list(a0.values)
+        bad_vals[2] = pks[2].encrypt(b"\x00" * 31 + b"\x07", rng)
+        acks[0] = (s0, Ack(a0.proposer, tuple(bad_vals)))
+        s1, a1 = acks[1]
+        ct = a1.values[3]
+        broken = Ciphertext(ct.u, ct.v, ct.u, SUITE)  # w = u: invalid
+        vals1 = list(a1.values)
+        vals1[3] = broken
+        acks[1] = (s1, Ack(a1.proposer, tuple(vals1)))
+        for sender, ack in acks:
+            for i in range(n):
+                out = nodes[i].handle_ack(sender, ack)
+                transcripts.append((i, sender, ack.proposer, out.fault))
+        results = {}
+        for i in range(n):
+            pk_set, share = nodes[i].generate()
+            results[i] = (pk_set.to_bytes(), share.x)
+            transcripts.append(sorted(nodes[i].proposals[0].values.items()))
+        return transcripts, results
+
+    t_pure, r_pure = run(native=False)
+    t_nat, r_nat = run(native=True)
+    assert t_pure == t_nat
+    assert r_pure == r_nat
+
+
+def test_native_dkg_registry_bounded_and_generation_safe():
+    """One registration per distinct commitment (memoized on the shared
+    object); hbe_dkg_clear bumps the generation so STALE cids fall back
+    (rc -1) instead of ever resolving to a different entry."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    nd = skg_mod._native_dkg(SUITE)
+    if nd is None:
+        pytest.skip("native engine unavailable")
+    lib = nd._lib
+    rng, sks, pks = _setup(4, seed=31)
+    skg, part = SyncKeyGen.new(0, sks[0], pks, 1, rng, SUITE)
+    before = int(lib.hbe_dkg_registry_size())
+    cid1 = nd.commit_id(part.commitment)
+    assert cid1 >= 0
+    assert int(lib.hbe_dkg_registry_size()) == before + 1
+    # memoized: second call registers nothing
+    assert nd.commit_id(part.commitment) == cid1
+    assert int(lib.hbe_dkg_registry_size()) == before + 1
+    # generation safety: a cleared registry must never let the stale cid
+    # resolve — ack_check reports fall-back, and a NEW registration at
+    # the same index gets a different (generation-tagged) cid.
+    lib.hbe_dkg_clear()
+    assert int(lib.hbe_dkg_registry_size()) == 0
+    ct = pks[0].encrypt(b"\x00" * 32, rng)
+    rc, _ = nd.ack_check(cid1, 1, 1, ct, sks[0].x)
+    assert rc == -1
+    skg2, part2 = SyncKeyGen.new(1, sks[1], pks, 1, rng, SUITE)
+    cid2 = nd.commit_id(part2.commitment)
+    assert cid2 >= 0 and cid2 != cid1
