@@ -313,6 +313,34 @@ class PublicKeySet:
 # tests/test_crypto_scheme.py::test_native_kem_matches_python.
 
 
+# Pre-rendered serde encoding of a scalar-suite Ciphertext (the exact
+# bytes serde.dumps emits: STRUCT "ct" + 4-field tuple of suite-name
+# string, GROUP u, BYTES v, GROUP w — wire.py _pack_ciphertext over the
+# serde grammar).  serde._encode consumes the `_serde_cache` memo, so
+# the N^2 ack/row ciphertexts a DKG epoch encodes into outgoing
+# contributions skip the recursive encoder.  Byte-equality with the
+# recursive path is pinned by tests (a wrong rendering here would be a
+# silent wire divergence).
+_SCALAR_NAME = b"scalar-insecure"
+_CT_HEAD = (
+    bytes([0x10, 2]) + b"ct" + bytes([0x06]) + (4).to_bytes(4, "big")
+    + bytes([0x05]) + len(_SCALAR_NAME).to_bytes(4, "big") + _SCALAR_NAME
+)
+_GRP_HEAD = (
+    bytes([0x11, len(_SCALAR_NAME)]) + _SCALAR_NAME + bytes([1])
+    + (32).to_bytes(4, "big")
+)
+
+
+def scalar_ct_serde(u_be32: bytes, v: bytes, w_be32: bytes) -> bytes:
+    return (
+        _CT_HEAD
+        + _GRP_HEAD + u_be32
+        + bytes([0x04]) + len(v).to_bytes(4, "big") + v
+        + _GRP_HEAD + w_be32
+    )
+
+
 class _ScalarKem:
     def __init__(self, lib: Any, suite: Suite) -> None:
         self._lib = lib
@@ -353,13 +381,15 @@ class _ScalarKem:
             out_u, out_v, out_w,
         )
         g, m = self._g_type, self._mod
+        u_b, v_b, w_b = bytes(out_u), bytes(out_v), bytes(out_w)
         ct = Ciphertext(
-            g(int.from_bytes(bytes(out_u), "big"), m),
-            bytes(out_v),
-            g(int.from_bytes(bytes(out_w), "big"), m),
+            g(int.from_bytes(u_b, "big"), m),
+            v_b,
+            g(int.from_bytes(w_b, "big"), m),
             self._suite,
         )
         object.__setattr__(ct, "_verify_ok", True)
+        object.__setattr__(ct, "_serde_cache", scalar_ct_serde(u_b, v_b, w_b))
         return ct
 
     def decrypt(self, sk: "SecretKey", ct: "Ciphertext") -> Optional[bytes]:

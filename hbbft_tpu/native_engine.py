@@ -232,6 +232,10 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_dkg_registry_size.argtypes = []
     lib.hbe_dkg_clear.restype = None
     lib.hbe_dkg_clear.argtypes = []
+    lib.hbe_serde_scan.restype = ctypes.c_int64
+    lib.hbe_serde_scan.argtypes = [
+        cp, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+    ]
     lib.hbe_dkg_ack_check.restype = ctypes.c_int32
     lib.hbe_dkg_ack_check.argtypes = [
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, cp, cp, cp, cp, u8p,

@@ -145,6 +145,8 @@ class _NativeDkg:
         self._lib.hbe_kem_encrypt_batch(
             pks, bytes(evals), n, rs, out_u, out_v, out_w
         )
+        from hbbft_tpu.crypto.keys import scalar_ct_serde
+
         g_type = type(self._suite.g1_generator())
         u_b, v_b, w_b = bytes(out_u), bytes(out_v), bytes(out_w)
         cts = []
@@ -157,6 +159,9 @@ class _NativeDkg:
                 self._suite,
             )
             object.__setattr__(ct, "_verify_ok", True)
+            object.__setattr__(
+                ct, "_serde_cache", scalar_ct_serde(u_b[s], v_b[s], w_b[s])
+            )
             cts.append(ct)
         return tuple(cts)
 

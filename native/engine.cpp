@@ -3149,6 +3149,185 @@ int32_t hbe_dkg_row_check(int64_t cid, int32_t our_pos, const uint8_t* plain,
   return 1;
 }
 
+// --- serde token scan (native half of utils/serde.loads) -------------------
+//
+// One pass over a serde payload producing a flat int64 TRIPLE stream the
+// Python builder walks without any per-byte work (utils/serde.py
+// _decode's take/u8/u32 calls were the measured bulk of DKG-payload
+// decoding).  Structural validation here mirrors the Python decoder's
+// checks EXACTLY where they are structural (bounds, canonical ints,
+// counts, depth, known tags); semantic checks (utf-8, struct/suite
+// registries, dict key rules, unpack validation) stay in Python.  Both
+// paths raise the same DecodeError class, so a malformed payload is
+// rejected either way — only the failure MESSAGE can differ.
+//
+// Triple layout per value node:
+//   NONE/TRUE/FALSE: [tag, 0, 0]
+//   INT:   [0x03 | sign<<8, mag_offset, mag_len]
+//   BYTES: [0x04, offset, len]    STR: [0x05, offset, len]
+//   TUPLE/LIST: [tag, count, 0] then `count` child nodes
+//   DICT:  [0x08, count, 0] then 2*count child nodes (k, v, k, v, ...)
+//   STRUCT:[0x10, name_offset, name_len] then ONE node (the fields)
+//   GROUP: [0x11, name_offset, name_len] then EXTRA triple
+//          [group_id, payload_offset, payload_len]
+// Returns the number of triples, -1 on malformed input, -2 when the
+// output buffer is too small (caller retries with a bigger one).
+
+namespace {
+const uint64_t SERDE_MAX_LEN = 1ull << 28;
+
+struct SerdeScan {
+  const uint8_t* d;
+  uint64_t len, pos = 0;
+  int64_t* out;
+  uint64_t max_triples, n = 0;
+  int err = 0;  // 0 ok, 1 malformed, 2 overflow
+
+  bool need(uint64_t k) {
+    if (pos + k > len) {
+      err = 1;
+      return false;
+    }
+    return true;
+  }
+  bool emit(int64_t a, int64_t b, int64_t c) {
+    if (n >= max_triples) {
+      err = 2;
+      return false;
+    }
+    out[3 * n] = a;
+    out[3 * n + 1] = b;
+    out[3 * n + 2] = c;
+    n++;
+    return true;
+  }
+  uint32_t u32() {
+    uint32_t v = ((uint32_t)d[pos] << 24) | ((uint32_t)d[pos + 1] << 16) |
+                 ((uint32_t)d[pos + 2] << 8) | d[pos + 3];
+    pos += 4;
+    return v;
+  }
+
+  void value(int depth) {
+    if (err) return;
+    if (depth > 64) {  // serde.MAX_DEPTH
+      err = 1;
+      return;
+    }
+    if (!need(1)) return;
+    uint8_t tag = d[pos++];
+    switch (tag) {
+      case 0x00:
+      case 0x01:
+      case 0x02:
+        emit(tag, 0, 0);
+        return;
+      case 0x03: {  // int: sign u8, len u32, magnitude
+        if (!need(5)) return;
+        uint8_t sign = d[pos++];
+        if (sign > 1) {
+          err = 1;
+          return;
+        }
+        uint64_t l = u32();
+        if (l > SERDE_MAX_LEN) {
+          err = 1;
+          return;
+        }
+        if (!need(l)) return;
+        if (l > 0 && d[pos] == 0) {  // non-minimal int
+          err = 1;
+          return;
+        }
+        if (sign == 1 && l == 0) {  // negative zero
+          err = 1;
+          return;
+        }
+        emit(0x03 | ((int64_t)sign << 8), (int64_t)pos, (int64_t)l);
+        pos += l;
+        return;
+      }
+      case 0x04:
+      case 0x05: {  // bytes / str
+        if (!need(4)) return;
+        uint64_t l = u32();
+        if (l > SERDE_MAX_LEN) {
+          err = 1;
+          return;
+        }
+        if (!need(l)) return;
+        emit(tag, (int64_t)pos, (int64_t)l);
+        pos += l;
+        return;
+      }
+      case 0x06:
+      case 0x07: {  // tuple / list
+        if (!need(4)) return;
+        uint64_t count = u32();
+        if (count > len - pos) {  // each element costs >= 1 byte
+          err = 1;
+          return;
+        }
+        if (!emit(tag, (int64_t)count, 0)) return;
+        for (uint64_t i = 0; i < count && !err; ++i) value(depth + 1);
+        return;
+      }
+      case 0x08: {  // dict
+        if (!need(4)) return;
+        uint64_t count = u32();
+        if (2 * count > len - pos) {
+          err = 1;
+          return;
+        }
+        if (!emit(tag, (int64_t)count, 0)) return;
+        for (uint64_t i = 0; i < 2 * count && !err; ++i) value(depth + 1);
+        return;
+      }
+      case 0x10: {  // struct: name u8-len, then fields value
+        if (!need(1)) return;
+        uint64_t nl = d[pos++];
+        if (!need(nl)) return;
+        if (!emit(0x10, (int64_t)pos, (int64_t)nl)) return;
+        pos += nl;
+        value(depth + 1);
+        return;
+      }
+      case 0x11: {  // group: name u8-len, group u8, payload u32-len
+        if (!need(1)) return;
+        uint64_t nl = d[pos++];
+        if (!need(nl)) return;
+        if (!emit(0x11, (int64_t)pos, (int64_t)nl)) return;
+        pos += nl;
+        if (!need(5)) return;
+        uint8_t grp = d[pos++];
+        uint64_t l = u32();
+        if (l > SERDE_MAX_LEN) {
+          err = 1;
+          return;
+        }
+        if (!need(l)) return;
+        if (!emit(grp, (int64_t)pos, (int64_t)l)) return;
+        pos += l;
+        return;
+      }
+      default:
+        err = 1;
+        return;
+    }
+  }
+};
+}  // namespace
+
+int64_t hbe_serde_scan(const uint8_t* data, uint64_t len, int64_t* out,
+                       uint64_t max_triples) {
+  SerdeScan s{data, len, 0, out, max_triples};
+  s.value(0);
+  if (!s.err && s.pos != s.len) s.err = 1;  // trailing bytes
+  if (s.err == 2) return -2;
+  if (s.err) return -1;
+  return (int64_t)s.n;
+}
+
 // Row evaluations for ack building (Poly.eval at x = 1..n_points):
 // coeffs_be = n_coeffs 32-byte BE scalars (ascending degree), out =
 // n_points * 32 bytes.

@@ -433,3 +433,111 @@ def test_bls_off_curve_point_rejected(rng):
     payload_at = idx + 2 + name_len + 1 + 4
     data[payload_at + 96] ^= 1  # flip a bit of y
     assert serde.try_loads(bytes(data)) is None
+
+
+def test_scalar_ct_serde_cache_matches_recursive_encoder():
+    """The pre-rendered `_serde_cache` memo the native KEM attaches must
+    be byte-identical to what the recursive encoder emits — a wrong
+    rendering would be a silent wire divergence."""
+    import random
+
+    from hbbft_tpu.crypto.keys import Ciphertext, SecretKey, scalar_ct_serde
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.utils import serde
+
+    suite = ScalarSuite()
+    rng = random.Random(9)
+    sk = SecretKey.random(rng, suite)
+    for msg in (b"\x00" * 32, b"hello world", b""):
+        ct = sk.public_key().encrypt(msg, rng)
+        # recursive-path encoding of an equal ciphertext WITHOUT a memo
+        bare = Ciphertext(ct.u, ct.v, ct.w, suite)
+        want = serde.dumps(bare)
+        got = scalar_ct_serde(
+            ct.u.value.to_bytes(32, "big"), ct.v,
+            ct.w.value.to_bytes(32, "big"),
+        )
+        assert got == want
+        # and the memo'd object round-trips identically
+        assert serde.dumps(ct) == want
+        assert serde.loads(want, suite=suite) == bare
+
+
+def test_native_scan_decode_matches_pure_decoder():
+    """The C token scan + builder must ACCEPT exactly what the recursive
+    decoder accepts (same objects) and REJECT exactly what it rejects —
+    checked over round-trips of representative structures, truncations,
+    and byte-flip corruptions of real encodings."""
+    import random
+
+    from hbbft_tpu.crypto.keys import SecretKey
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.utils import serde
+
+    lib = serde._native_scan(b"\x00")
+    if lib is None:
+        import pytest
+
+        pytest.skip("native engine unavailable")
+
+    suite = ScalarSuite()
+    rng = random.Random(5)
+    sk = SecretKey.random(rng, suite)
+    ct = sk.public_key().encrypt(b"payload bytes", rng)
+    samples = [
+        None, True, False, 0, 1, -1, 2**300, -(2**300),
+        b"", b"abc", "txt", "ünicode",
+        (1, (2, b"x"), [None, True]), {"k": 1, 2: (3,)}, [],
+        ct, (ct, ct), {"ct": ct},
+        sk.public_key(),
+    ]
+
+    def pure_loads(data):
+        r = serde._Reader(data, None)
+        obj = serde._decode(r, 0)
+        if r.pos != len(r.data):
+            raise serde.DecodeError("trailing bytes")
+        return obj
+
+    encodings = []
+    for obj in samples:
+        try:
+            enc = serde.dumps(obj)
+        except serde.EncodeError:
+            continue
+        encodings.append(enc)
+        assert serde.loads(enc, suite=suite if obj is ct else None) is not None or obj is None
+        # native result equals pure result exactly
+        assert serde.loads(enc) == pure_loads(enc)
+
+    # corruption sweep: every truncation point of a short encoding plus
+    # byte flips across a ciphertext encoding — accept/reject must agree
+    rng2 = random.Random(7)
+    enc = serde.dumps((1, b"ab", "c", ct))
+    for cut in range(len(enc)):
+        data = enc[:cut]
+        try:
+            want = pure_loads(data)
+        except serde.DecodeError:
+            want = "ERR"
+        try:
+            got = serde.loads(data)
+        except serde.DecodeError:
+            got = "ERR"
+        assert (got == "ERR") == (want == "ERR"), cut
+        if want != "ERR":
+            assert got == want
+    for _ in range(300):
+        i = rng2.randrange(len(enc))
+        data = enc[:i] + bytes([enc[i] ^ (1 << rng2.randrange(8))]) + enc[i + 1:]
+        try:
+            want = pure_loads(data)
+        except serde.DecodeError:
+            want = "ERR"
+        try:
+            got = serde.loads(data)
+        except serde.DecodeError:
+            got = "ERR"
+        assert (got == "ERR") == (want == "ERR"), i
+        if want != "ERR":
+            assert got == want
