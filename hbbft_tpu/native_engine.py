@@ -235,6 +235,7 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_serde_scan.restype = ctypes.c_int64
     lib.hbe_serde_scan.argtypes = [
         cp, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+        ctypes.c_int64, ctypes.c_uint64,
     ]
     lib.hbe_dkg_ack_check.restype = ctypes.c_int32
     lib.hbe_dkg_ack_check.argtypes = [

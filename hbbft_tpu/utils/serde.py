@@ -172,7 +172,10 @@ def _encode(obj: Any, out: bytearray, depth: int) -> None:
                 cached = obj.__dict__.get("_serde_cache")
             except AttributeError:
                 cached = None
-            if cached is not None:
+            # depth + 2: the memo'd struct subtree reaches two levels
+            # below this node (fields tuple -> leaf values); splicing it
+            # deeper would let dumps emit bytes loads rejects.
+            if cached is not None and depth + 2 <= MAX_DEPTH:
                 out += cached
                 return
             _, pack, _ = _STRUCTS[name]
@@ -378,14 +381,19 @@ def _native_scan(data: bytes):
     """
     global _NATIVE_SCAN_LIB
     lib = _NATIVE_SCAN_LIB
-    if lib is False:
+    if lib is False or lib is None:
+        # Only use an engine that is ALREADY loaded — decoding must
+        # never trigger the engine's g++ build (a lightweight consumer's
+        # first loads() would block on a minutes-class compile).  The
+        # probe re-runs until an engine appears (e.g. the first
+        # NativeQhbNet / scalar-KEM user loads it), then caches.
         try:
             from hbbft_tpu import native_engine  # lazy: import cycle
 
-            lib = native_engine.get_lib()
+            lib = native_engine._LIBS.get(4)
         except Exception:
             lib = None
-        _NATIVE_SCAN_LIB = lib
+        _NATIVE_SCAN_LIB = lib if lib is not None else None
     if lib is None:
         return None
     import ctypes
@@ -396,7 +404,9 @@ def _native_scan(data: bytes):
     # exact worst case (one triple per input byte, +1 for the root).
     for triples in (n // 2 + 64, n + 2):
         buf = (ctypes.c_int64 * (3 * triples))()
-        rc = int(lib.hbe_serde_scan(data, n, buf, triples))
+        rc = int(
+            lib.hbe_serde_scan(data, n, buf, triples, MAX_DEPTH, _MAX_LEN)
+        )
         if rc == -2:
             continue
         if rc < 0:

@@ -3174,13 +3174,15 @@ int32_t hbe_dkg_row_check(int64_t cid, int32_t our_pos, const uint8_t* plain,
 // output buffer is too small (caller retries with a bigger one).
 
 namespace {
-const uint64_t SERDE_MAX_LEN = 1ull << 28;
-
 struct SerdeScan {
   const uint8_t* d;
   uint64_t len, pos = 0;
   int64_t* out;
   uint64_t max_triples, n = 0;
+  // Limits supplied by the CALLER (serde.MAX_DEPTH / serde._MAX_LEN) so
+  // the two decoders can never silently disagree after a constant edit.
+  int64_t max_depth = 64;
+  uint64_t max_len = 1ull << 28;
   int err = 0;  // 0 ok, 1 malformed, 2 overflow
 
   bool need(uint64_t k) {
@@ -3210,7 +3212,7 @@ struct SerdeScan {
 
   void value(int depth) {
     if (err) return;
-    if (depth > 64) {  // serde.MAX_DEPTH
+    if (depth > max_depth) {  // serde.MAX_DEPTH (caller-supplied)
       err = 1;
       return;
     }
@@ -3230,7 +3232,7 @@ struct SerdeScan {
           return;
         }
         uint64_t l = u32();
-        if (l > SERDE_MAX_LEN) {
+        if (l > max_len) {
           err = 1;
           return;
         }
@@ -3251,7 +3253,7 @@ struct SerdeScan {
       case 0x05: {  // bytes / str
         if (!need(4)) return;
         uint64_t l = u32();
-        if (l > SERDE_MAX_LEN) {
+        if (l > max_len) {
           err = 1;
           return;
         }
@@ -3301,7 +3303,7 @@ struct SerdeScan {
         if (!need(5)) return;
         uint8_t grp = d[pos++];
         uint64_t l = u32();
-        if (l > SERDE_MAX_LEN) {
+        if (l > max_len) {
           err = 1;
           return;
         }
@@ -3319,8 +3321,9 @@ struct SerdeScan {
 }  // namespace
 
 int64_t hbe_serde_scan(const uint8_t* data, uint64_t len, int64_t* out,
-                       uint64_t max_triples) {
-  SerdeScan s{data, len, 0, out, max_triples};
+                       uint64_t max_triples, int64_t max_depth,
+                       uint64_t max_len) {
+  SerdeScan s{data, len, 0, out, max_triples, 0, max_depth, max_len};
   s.value(0);
   if (!s.err && s.pos != s.len) s.err = 1;  // trailing bytes
   if (s.err == 2) return -2;

@@ -541,3 +541,56 @@ def test_native_scan_decode_matches_pure_decoder():
         assert (got == "ERR") == (want == "ERR"), i
         if want != "ERR":
             assert got == want
+
+
+def test_depth_and_memo_boundaries_match_both_paths():
+    """Depth 64 accepted, 65 rejected — by BOTH decoders (the native
+    scanner takes the limits as arguments, so a constant edit cannot
+    make them diverge); and a memo'd ciphertext nested near MAX_DEPTH
+    falls back to the recursive encoder so dumps never emits bytes
+    loads rejects."""
+    import random
+
+    from hbbft_tpu.crypto.keys import SecretKey
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.utils import serde
+
+    def pure_loads(data):
+        r = serde._Reader(data, None)
+        obj = serde._decode(r, 0)
+        if r.pos != len(r.data):
+            raise serde.DecodeError("trailing bytes")
+        return obj
+
+    def nested(depth):
+        return b"\x06\x00\x00\x00\x01" * depth + b"\x00"
+
+    ok = nested(serde.MAX_DEPTH)  # value at depth MAX_DEPTH: accepted
+    bad = nested(serde.MAX_DEPTH + 1)
+    assert serde.loads(ok) is not None or True  # no raise
+    assert pure_loads(ok) == serde.loads(ok)
+    for data in (bad,):
+        import pytest
+
+        with pytest.raises(serde.DecodeError):
+            pure_loads(data)
+        with pytest.raises(serde.DecodeError):
+            serde.loads(data)
+
+    # memo near the depth limit: round-trip must hold whenever dumps
+    # succeeds
+    suite = ScalarSuite()
+    rng = random.Random(3)
+    ct = SecretKey.random(rng, suite).public_key().encrypt(b"x" * 8, rng)
+    assert "_serde_cache" in ct.__dict__
+    obj = ct
+    for _ in range(serde.MAX_DEPTH - 2):
+        obj = (obj,)
+    enc = serde.dumps(obj)  # deepest legal nesting for the ct subtree
+    assert serde.loads(enc, suite=suite) is not None
+    try:
+        serde.dumps(((obj,),))
+        deeper_ok = True
+    except serde.EncodeError:
+        deeper_ok = False
+    assert not deeper_ok  # encoder refuses past the limit either way
