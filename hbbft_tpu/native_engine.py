@@ -151,6 +151,10 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     ]
     lib.hbe_run.restype = ctypes.c_uint64
     lib.hbe_run.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.hbe_run_mt.restype = ctypes.c_uint64
+    lib.hbe_run_mt.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32,
+    ]
     lib.hbe_queue_len.restype = ctypes.c_uint64
     lib.hbe_queue_len.argtypes = [ctypes.c_void_p]
     lib.hbe_delivered.restype = ctypes.c_uint64
@@ -473,12 +477,32 @@ class NativeQhbNet:
         flush_every: int = 1,
         external_crypto: Optional[bool] = None,
         adversary: Any = None,
+        threads: int = 1,
     ) -> None:
         lib = get_lib(_words_for(n))
         if lib is None:
             raise RuntimeError("native engine unavailable (no compiler?)")
         self.lib = lib
         self.n = n
+        # Multicore generation-parallel delivery (engine_run_mt): scalar
+        # mode only — the external-crypto flush cadence (one verify
+        # callback per flush_every deliveries) and adversary replay are
+        # inherently sequential orderings.  Byte-identity with threads=1
+        # is pinned by tests/test_native_engine.py.
+        self.threads = int(threads)
+        if self.threads > 1:
+            if external_crypto or (
+                external_crypto is None
+                and suite is not None
+                and not isinstance(suite, ScalarSuite)
+            ):
+                raise ValueError(
+                    "threads > 1 requires the scalar-suite internal "
+                    "crypto mode (external-crypto flush cadence is "
+                    "sequential)"
+                )
+            if adversary is not None:
+                raise ValueError("threads > 1 does not support adversaries")
         f = num_faulty if num_faulty is not None else (n - 1) // 3
         assert 3 * f < n
         self.f = f
@@ -975,7 +999,12 @@ class NativeQhbNet:
         self._raise_cb_error()
 
     def run(self, max_deliveries: int = 1 << 62) -> int:
-        done = int(self.lib.hbe_run(self.handle, max_deliveries))
+        if self.threads > 1:
+            done = int(
+                self.lib.hbe_run_mt(self.handle, max_deliveries, self.threads)
+            )
+        else:
+            done = int(self.lib.hbe_run(self.handle, max_deliveries))
         self._raise_cb_error()
         return done
 

@@ -55,7 +55,7 @@ def build_and_load(
             os.makedirs(os.path.dirname(so), exist_ok=True)
             tmp = f"{so}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
                  *extra_flags, "-o", tmp, src],
                 check=True,
                 capture_output=True,

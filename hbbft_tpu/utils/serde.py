@@ -390,7 +390,12 @@ def _native_scan(data: bytes):
         try:
             from hbbft_tpu import native_engine  # lazy: import cycle
 
-            lib = native_engine._LIBS.get(4)
+            # Any loaded width works — hbe_serde_scan is NodeSet-width
+            # independent (a >256-node net loads only the w8 build).
+            lib = next(
+                (v for v in native_engine._LIBS.values() if v is not None),
+                None,
+            )
         except Exception:
             lib = None
         _NATIVE_SCAN_LIB = lib if lib is not None else None

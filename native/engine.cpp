@@ -32,8 +32,10 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <atomic>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "sha3_gf.h"
@@ -324,7 +326,7 @@ inline Bytes kdf_stream(const Bytes& seed, size_t n) {
 // index set: every node combining the same (FIFO-typical) first-t+1
 // index set otherwise pays the modular inverse + O(k^2) mulmods again —
 // the single hottest share of the N=64 era-change combines.
-inline const std::vector<U256>& lagrange_cached(const std::vector<int>& idxs);
+inline std::vector<U256> lagrange_cached(const std::vector<int>& idxs);
 
 inline std::vector<U256> lagrange(const std::vector<int>& idxs) {
   size_t k = idxs.size();
@@ -353,14 +355,19 @@ inline std::vector<U256> lagrange(const std::vector<int>& idxs) {
   return coeffs;
 }
 
-inline const std::vector<U256>& lagrange_cached(const std::vector<int>& idxs) {
+inline std::vector<U256> lagrange_cached(const std::vector<int>& idxs) {
+  // Returns by VALUE under a mutex: multicore workers share this cache,
+  // and a reference could be invalidated by a concurrent eviction (the
+  // old single-thread version returned a reference and evicted one
+  // entry FIFO to keep callers' references alive — by-value removes
+  // that aliasing subtlety entirely; the copy is t+1 scalars).
+  static std::mutex mu;
   static std::map<std::vector<int>, std::vector<U256>> cache;
   static std::deque<std::vector<int>> order;
+  std::lock_guard<std::mutex> lk(mu);
   auto it = cache.find(idxs);
   if (it == cache.end()) {
     if (cache.size() > 4096) {
-      // evict ONE entry FIFO — wholesale clear() would invalidate any
-      // reference a caller still holds from an earlier call
       cache.erase(order.front());
       order.pop_front();
     }
@@ -924,6 +931,9 @@ struct Node {
   std::vector<Pending> pool;
   std::vector<Pending> flush_scratch;  // engine_flush_pool drain buffer
   bool flushing = false;               // reentrancy guard for the scratch
+  int suppress_emit = 0;  // scoped stale-callback guard (per node: the
+                          // windows open and close within one delivery,
+                          // so this is worker-local in multicore mode)
   std::vector<Fault> faults;
   std::vector<std::pair<int, EMsg>> next_era_buffer;
   std::vector<BatchData> pending_batches;
@@ -977,17 +987,16 @@ struct Engine {
   std::vector<Node> nodes;
   std::deque<QItem> queue;
   uint64_t delivered = 0;
-  int suppress_emit = 0;
   BatchEventCb batch_cb = nullptr;
   ContribCb contrib_cb = nullptr;
   // current batch exposed to Python during batch_cb
   std::vector<std::pair<int, BytesP>> cur_batch;  // str-sorted (proposer, payload)
-  int depth = 0;  // >0 while inside a processing unit (nested entry points)
+  std::atomic<int> depth{0};  // >0 while inside a processing unit (nested entry points)
   // -- external-crypto mode ------------------------------------------------
   bool ext = false;
   int flush_every = 1;  // 0 = flush only when the delivery queue runs dry
   uint64_t since_flush = 0;
-  uint64_t pool_items = 0;  // total pending across all nodes
+  std::atomic<uint64_t> pool_items{0};  // total pending across all nodes
   bool in_flush = false;
   VerifyBatchCb verify_cb = nullptr;
   SignCb sign_cb = nullptr;
@@ -1007,6 +1016,12 @@ struct Engine {
   // codeword, collisions aside).  Bounded FIFO.
   std::map<Root, BytesP> decoded_roots;
   std::deque<Root> decoded_order;
+  // -- multicore (generation-parallel) mode: see engine_run_mt ----------
+  bool mt_active = false;
+  std::mutex cache_mu;             // decoded_roots / mask_by_acc
+  std::recursive_mutex cb_mu;      // cur_batch + batch_cb (a batch
+                                   // callback may propose, re-entering
+                                   // commit_events on the same thread)
   // Per-message-type delivery profiling (rdtsc cycles + counts).
   uint64_t prof_cycles[16] = {};
   uint64_t prof_count[16] = {};
@@ -1031,6 +1046,10 @@ inline void pool_push(Engine& e, Node& node, Pending&& p) {
 // Engine mechanics: emission, faults, pool flush, merkle/RS helpers
 // ===========================================================================
 
+// Multicore emission redirection: when set, the current worker's
+// delivery is accumulating its emissions for ordered splicing.
+thread_local std::vector<QItem>* tl_emit_sink = nullptr;
+
 struct EngineOps {
   Engine& e;
   Node& node;
@@ -1053,28 +1072,37 @@ struct EngineOps {
   }
 
   // -- emission (drops when a stale-callback guard set suppress_emit) ---
+  //
+  // Multicore mode: workers never touch the shared queue — emissions
+  // land in the worker's per-delivery slot (tl_emit_sink) and the
+  // scheduler splices them back IN SOURCE-DELIVERY ORDER, reproducing
+  // the sequential FIFO append order exactly (engine_run_mt notes).
+  void emit(int dest, std::shared_ptr<const EMsg> msg) {
+    if (tl_emit_sink) tl_emit_sink->push_back({node.id, dest, std::move(msg)});
+    else e.queue.push_back({node.id, dest, std::move(msg)});
+  }
   void send(int dest, const EMsg& m) {
-    if (e.suppress_emit) return;
+    if (node.suppress_emit) return;
     if (dest == node.id) return;
-    e.queue.push_back({node.id, dest, outgoing(m)});
+    emit(dest, outgoing(m));
   }
   void broadcast(const EMsg& m) {
-    if (e.suppress_emit) return;
+    if (node.suppress_emit) return;
     auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
-      if (d != node.id) e.queue.push_back({node.id, d, shared});
+      if (d != node.id) emit(d, shared);
   }
   void broadcast_except(const EMsg& m, const NodeSet& except) {
-    if (e.suppress_emit) return;
+    if (node.suppress_emit) return;
     auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
-      if (d != node.id && !except.has(d)) e.queue.push_back({node.id, d, shared});
+      if (d != node.id && !except.has(d)) emit(d, shared);
   }
   void send_nodes(const EMsg& m, const NodeSet& dests) {
-    if (e.suppress_emit) return;
+    if (node.suppress_emit) return;
     auto shared = outgoing(m);
     for (int d = 0; d < e.n; ++d)
-      if (d != node.id && dests.has(d)) e.queue.push_back({node.id, d, shared});
+      if (d != node.id && dests.has(d)) emit(d, shared);
   }
   void fault(int subject, const char* kind) {
     node.faults.push_back({subject, kind});
@@ -1362,10 +1390,9 @@ struct Ctx {
                       std::shared_ptr<Ts> ts, int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
     bool live_epoch = node.era == era && node.hb_init && node.hb.epoch == epoch;
-    if (!live_epoch) e.suppress_emit++;
+    if (!live_epoch) node.suppress_emit++;
     std::vector<uint8_t> parity_out;
     // inner: TS._on_verified
-    uint64_t t12 = prof_tick();
     if (!ts->terminated) {
       if (!ok) {
         ops.fault(sender, F_TS_INVALID);
@@ -1378,13 +1405,10 @@ struct Ctx {
         ts_try_output(*ts, parity_out);
       }
     }
-    e.prof_cycles[12] += prof_tick() - t12;
-    e.prof_count[12]++;
     // lift: coin scope (round / BA termination / same instance), then the
     // subset-output and epoch-advance boundaries (_on_ba_step ->
     // _guard_epoch(_on_subset_step) -> _advance in the Python chain).
     if (live_epoch) {
-      uint64_t t15 = prof_tick();
       EpochState& st = node.hb.state;
       if (!parity_out.empty()) {
         Ba& ba = st.proposals[proposer].ba;
@@ -1394,10 +1418,8 @@ struct Ctx {
       }
       hb_drain_subset_outputs(st);
       hb_advance();
-      e.prof_cycles[15] += prof_tick() - t15;
-      e.prof_count[15]++;
     }
-    if (!live_epoch) e.suppress_emit--;
+    if (!live_epoch) node.suppress_emit--;
   }
 
   void ts_try_output(Ts& ts, std::vector<uint8_t>& parity_out) {
@@ -1433,7 +1455,7 @@ struct Ctx {
     by_index.resize(threshold + 1);
     std::vector<int> idxs;
     for (auto& kv : by_index) idxs.push_back(kv.first);
-    const std::vector<U256>& lam = lagrange_cached(idxs);
+    std::vector<U256> lam = lagrange_cached(idxs);
     U256 acc = U256_ZERO;
     for (size_t i = 0; i < by_index.size(); ++i)
       acc = addmod(acc, mulmod(lam[i], by_index[i].second));
@@ -2120,12 +2142,19 @@ struct Ctx {
           shards[kv.second->index] = &kv.second->value;
       if ((int)shards.size() < bc.data_shards) continue;
       // Network-wide decode cache (see Engine::decoded_roots).
-      auto hit = e.decoded_roots.find(root);
-      if (hit != e.decoded_roots.end()) {
-        bc.value = hit->second;
-        bc.terminated = true;
-        subset_on_bc_value(st, proposer, bc.value);
-        return;
+      {
+        BytesP cached;
+        {
+          std::lock_guard<std::mutex> lk(e.cache_mu);
+          auto hit = e.decoded_roots.find(root);
+          if (hit != e.decoded_roots.end()) cached = hit->second;
+        }
+        if (cached) {
+          bc.value = cached;
+          bc.terminated = true;
+          subset_on_bc_value(st, proposer, bc.value);
+          return;
+        }
       }
       size_t len0 = SIZE_MAX;
       bool equal_len = true;
@@ -2192,11 +2221,14 @@ struct Ctx {
         return;
       }
       BytesP vp = std::make_shared<const Bytes>(std::move(value));
-      e.decoded_roots.emplace(root, vp);
-      e.decoded_order.push_back(root);
-      if (e.decoded_order.size() > DECODED_ROOTS_MAX) {
-        e.decoded_roots.erase(e.decoded_order.front());
-        e.decoded_order.pop_front();
+      {
+        std::lock_guard<std::mutex> lk(e.cache_mu);
+        e.decoded_roots.emplace(root, vp);
+        e.decoded_order.push_back(root);
+        if (e.decoded_order.size() > DECODED_ROOTS_MAX) {
+          e.decoded_roots.erase(e.decoded_order.front());
+          e.decoded_order.pop_front();
+        }
       }
       bc.value = vp;
       bc.terminated = true;
@@ -2254,7 +2286,7 @@ struct Ctx {
   void td_ct_checked_cb(int era, int epoch, int proposer,
                         std::shared_ptr<Td> td, bool ok) {
     bool live = node.era == era && node.hb_init && node.hb.epoch == epoch;
-    if (!live) e.suppress_emit++;
+    if (!live) node.suppress_emit++;
     std::vector<BytesP> plain_out;
     // inner: ThresholdDecrypt._on_ciphertext_checked
     if (!td->terminated) {
@@ -2304,7 +2336,7 @@ struct Ctx {
       hb_on_decrypt_boundary(proposer, td, plain_out);
       hb_advance();
     }
-    if (!live) e.suppress_emit--;
+    if (!live) node.suppress_emit--;
   }
 
   void td_submit_share(int era, int epoch, int proposer, std::shared_ptr<Td> td,
@@ -2346,7 +2378,7 @@ struct Ctx {
                       int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
     bool live = node.era == era && node.hb_init && node.hb.epoch == epoch;
-    if (!live) e.suppress_emit++;
+    if (!live) node.suppress_emit++;
     std::vector<BytesP> plain_out;
     if (!td->terminated) {  // Python: terminated check BEFORE the ok check
       if (!ok) {
@@ -2364,7 +2396,7 @@ struct Ctx {
       hb_on_decrypt_boundary(proposer, td, plain_out);
       hb_advance();
     }
-    if (!live) e.suppress_emit--;
+    if (!live) node.suppress_emit--;
   }
 
   void td_handle_message(EpochState& st, int proposer, std::shared_ptr<Td> td,
@@ -2428,7 +2460,7 @@ struct Ctx {
     by_index.resize(threshold + 1);
     std::vector<int> idxs;
     for (auto& kv : by_index) idxs.push_back(kv.first);
-    const std::vector<U256>& lam = lagrange_cached(idxs);
+    std::vector<U256> lam = lagrange_cached(idxs);
     U256 acc = U256_ZERO;
     for (size_t i = 0; i < by_index.size(); ++i)
       acc = addmod(acc, mulmod(lam[i], by_index[i].second));
@@ -2437,22 +2469,33 @@ struct Ctx {
     Root key;
     std::memcpy(key.data(), acc_be, 32);
     size_t need = td.ct.v.size();
-    auto it = e.mask_by_acc.find(key);
-    if (it == e.mask_by_acc.end() || it->second.size() < need) {
-      Bytes seed = canon2("kem", Bytes((const char*)acc_be, 32));
-      Bytes mask = kdf_stream(seed, need);
-      if (it == e.mask_by_acc.end()) {
-        it = e.mask_by_acc.emplace(key, std::move(mask)).first;
-        e.mask_order.push_back(key);
-        if (e.mask_order.size() > MASK_CACHE_MAX) {
-          e.mask_by_acc.erase(e.mask_order.front());
-          e.mask_order.pop_front();
+    Bytes mt_mask_copy;  // multicore: hold a copy (eviction can race)
+    const Bytes* mask_p = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(e.cache_mu);
+      auto it = e.mask_by_acc.find(key);
+      if (it == e.mask_by_acc.end() || it->second.size() < need) {
+        Bytes seed = canon2("kem", Bytes((const char*)acc_be, 32));
+        Bytes mask = kdf_stream(seed, need);
+        if (it == e.mask_by_acc.end()) {
+          it = e.mask_by_acc.emplace(key, std::move(mask)).first;
+          e.mask_order.push_back(key);
+          if (e.mask_order.size() > MASK_CACHE_MAX) {
+            e.mask_by_acc.erase(e.mask_order.front());
+            e.mask_order.pop_front();
+          }
+        } else {
+          it->second = std::move(mask);
         }
+      }
+      if (e.mt_active) {
+        mt_mask_copy = it->second;
+        mask_p = &mt_mask_copy;
       } else {
-        it->second = std::move(mask);
+        mask_p = &it->second;  // single-thread: no eviction can intervene
       }
     }
-    const Bytes& mask = it->second;
+    const Bytes& mask = *mask_p;
     Bytes plain = td.ct.v;
     // word-wise XOR via raw pointers (the indexed std::string loop
     // cannot vectorize and dominated big-ciphertext combines)
@@ -2725,6 +2768,11 @@ struct Ctx {
     while (!node.pending_batches.empty()) {
       BatchData bd = std::move(node.pending_batches.front());
       node.pending_batches.erase(node.pending_batches.begin());
+      // cur_batch is engine-global (the hbe_batch_* accessors read it
+      // during the callback); cb_mu serializes concurrent workers'
+      // batch events.  Recursive: the callback may propose, which
+      // re-enters here on the same thread.
+      std::lock_guard<std::recursive_mutex> lk(e.cb_mu);
       e.cur_batch = bd.contributions;
       if (e.batch_cb) e.batch_cb(node.id, bd.era, bd.epoch);
     }
@@ -2774,17 +2822,19 @@ void engine_flush_pool(Engine& e, Node& node) {
     for (Pending& p : items) {
       uint64_t t0 = prof_tick();
       pending_run(e, node, p, p.pre_ok);
-      uint64_t dt = prof_tick() - t0;
-      e.prof_cycles[14] += dt;
-      e.prof_count[14]++;
-      // Continuation tail split (era-change diagnosis, CLAUDE.md r4):
-      // slot 13 tallies continuations costing > 1M cycles (the
-      // big-payload decrypt/decode events); slot 11 keeps the max.
-      if (dt > 1000000) {
-        e.prof_cycles[13] += dt;
-        e.prof_count[13]++;
+      if (!e.mt_active) {  // profiling counters are single-writer only
+        uint64_t dt = prof_tick() - t0;
+        e.prof_cycles[14] += dt;
+        e.prof_count[14]++;
+        // Continuation tail split (era-change diagnosis, CLAUDE.md r4):
+        // slot 13 tallies continuations costing > 1M cycles (the
+        // big-payload decrypt/decode events); slot 11 keeps the max.
+        if (dt > 1000000) {
+          e.prof_cycles[13] += dt;
+          e.prof_count[13]++;
+        }
+        if (dt > e.prof_cycles[11]) e.prof_cycles[11] = dt;
       }
-      if (dt > e.prof_cycles[11]) e.prof_cycles[11] = dt;
     }
     items.clear();
   }
@@ -2869,6 +2919,97 @@ void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
   if (!e.ext) engine_flush_pool(e, node);
   else if (node.tampered) engine_flush_ext_node(e, node);
   e.depth--;
+}
+
+// ---------------------------------------------------------------------------
+// Multicore generation-parallel scheduler (round 5; SURVEY §5.8's sharded
+// delivery queue).
+//
+// WHY this is byte-identical to the sequential FIFO loop:
+//   * Sequential FIFO processing is breadth-first by GENERATIONS: every
+//     message in the current queue is processed before any message it
+//     emitted (emissions append at the tail).
+//   * Within a generation, deliveries to DIFFERENT nodes touch disjoint
+//     mutable state: all protocol state is per-Node; the only shared
+//     structures are pure-function caches (decoded_roots, the KDF mask
+//     cache, Lagrange coefficients — mutex-guarded; cache-content
+//     differences can only change WORK, never verdicts) and the
+//     Python-callback staging area (cb_mu-serialized; the Python side
+//     keys everything by node with per-node rngs, so cross-node
+//     callback order is output-invariant).
+//   * Deliveries to the SAME node run in their original queue order on
+//     one worker, preserving each node's exact sequential transition
+//     sequence (scalar-mode pool flushes are per-unit and node-local).
+//   * Each delivery's emissions are captured in its own slot and
+//     spliced back in SOURCE-DELIVERY ORDER — exactly the order the
+//     sequential loop would have appended them.
+// Hence the global delivery sequence seen by every node — and therefore
+// every output, fault, and batch — is identical to engine_run's, which
+// the multicore equivalence tests pin.  Scalar mode only: external-
+// crypto flush cadence and adversary replay are inherently sequential
+// (the Python layer rejects those combinations).
+uint64_t engine_run_mt(Engine& e, uint64_t max_deliveries, int n_threads) {
+  uint64_t processed = 0;
+  e.mt_active = true;
+  std::vector<QItem> gen;
+  std::vector<std::vector<uint32_t>> by_dest(e.n);
+  while (processed < max_deliveries && !e.queue.empty()) {
+    uint64_t take = e.queue.size();
+    if (take > max_deliveries - processed) take = max_deliveries - processed;
+    gen.clear();
+    gen.reserve(take);
+    for (uint64_t i = 0; i < take; ++i) {
+      gen.push_back(std::move(e.queue.front()));
+      e.queue.pop_front();
+    }
+    std::vector<int> dests;  // distinct destinations, first-seen order
+    for (uint64_t i = 0; i < take; ++i) {
+      int d = gen[i].dest;
+      if (by_dest[d].empty()) dests.push_back(d);
+      by_dest[d].push_back((uint32_t)i);
+    }
+    std::vector<std::vector<QItem>> emitted(take);
+    std::atomic<size_t> next{0};
+    auto worker = [&]() {
+      for (;;) {
+        size_t di = next.fetch_add(1);
+        if (di >= dests.size()) return;
+        Node& node = e.nodes[dests[di]];
+        for (uint32_t idx : by_dest[dests[di]]) {
+          if (node.silent) continue;
+          node.handled++;
+          tl_emit_sink = &emitted[idx];
+          engine_unit(e, node, [&](Ctx& ctx) {
+            ctx.deliver(gen[idx].sender, *gen[idx].msg);
+          });
+          tl_emit_sink = nullptr;
+        }
+      }
+    };
+    if (n_threads <= 1 || dests.size() <= 1) {
+      worker();
+    } else {
+      // Spawn-per-generation keeps the scheduler trivially correct; a
+      // persistent pool with a start barrier would shave ~tens of us
+      // per generation on a real multicore host (noted as the obvious
+      // next step in BASELINE.md's round-5 multicore design note).
+      std::vector<std::thread> pool;
+      int spawn = n_threads;
+      if ((size_t)spawn > dests.size()) spawn = (int)dests.size();
+      for (int t = 1; t < spawn; ++t) pool.emplace_back(worker);
+      worker();
+      for (auto& th : pool) th.join();
+    }
+    // Sequential epilogue: delivered accounting + ordered splice.
+    for (uint64_t i = 0; i < take; ++i) {
+      if (!e.nodes[gen[i].dest].silent) e.delivered++;
+      for (QItem& q : emitted[i]) e.queue.push_back(std::move(q));
+    }
+    for (int d : dests) by_dest[d].clear();
+    processed += take;
+  }
+  e.mt_active = false;
+  return processed;
 }
 
 uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
@@ -3469,6 +3610,19 @@ int32_t hbe_propose(void* h, int32_t node, int32_t era, const uint8_t* payload,
 
 uint64_t hbe_run(void* h, uint64_t max_deliveries) {
   return engine_run(*(Engine*)h, max_deliveries);
+}
+
+// Multicore run (engine_run_mt notes above).  Falls back to the
+// sequential loop whenever a sequential-only feature is active
+// (external crypto's flush cadence, adversary hooks) — the Python
+// layer also rejects those combinations loudly.
+uint64_t hbe_run_mt(void* h, uint64_t max_deliveries, int32_t n_threads) {
+  Engine& e = *(Engine*)h;
+  bool tampered = false;
+  for (auto& nd : e.nodes) tampered = tampered || nd.tampered;
+  if (n_threads <= 1 || e.ext || e.pre_crank_cb || tampered)
+    return engine_run(e, max_deliveries);
+  return engine_run_mt(e, max_deliveries, n_threads);
 }
 
 uint64_t hbe_queue_len(void* h) { return ((Engine*)h)->queue.size(); }

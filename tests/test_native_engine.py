@@ -391,3 +391,103 @@ def test_equivalence_era_change_with_silent_faulty():
         )
     assert py_done(pynet) and nat_done(nat)
     assert_equivalent(pynet, nat)
+
+
+def test_multicore_with_silent_faulty_matches_sequential():
+    """MT worker-loop silent skips + the epilogue's delivered accounting
+    must match the sequential loop's at-pop silent check."""
+    def run_one(threads_):
+        nat = native_engine.NativeQhbNet(
+            7, seed=3, batch_size=BATCH_SIZE, session_id=SESSION,
+            threads=threads_,
+        )  # default faulty: last f=2 nodes silent
+        assert nat.faulty_ids
+        for nid in nat.correct_ids:
+            nat.send_input(nid, Input.user(f"s{nid}"))
+        nat.run_until(
+            lambda e: all(
+                len(e.nodes[i].outputs) >= 1 for i in e.correct_ids
+            ),
+            chunk=5000,
+        )
+        out = {
+            "delivered": nat.delivered,
+            "outputs": [
+                [batch_key(b) for b in nat.nodes[i].outputs]
+                for i in nat.correct_ids
+            ],
+            "faults": [nat.faults(i) for i in nat.correct_ids],
+        }
+        nat.close()
+        return out
+
+    assert run_one(3) == run_one(1)
+
+
+@pytest.mark.parametrize("threads", [2, 4])
+def test_multicore_byte_identical_to_sequential(threads):
+    """The generation-parallel scheduler (engine_run_mt) must produce
+    BYTE-identical outputs, faults, and delivery counts to the
+    sequential loop at the same seed — including a full era change (the
+    hairiest path: batch callbacks proposing re-entrantly from worker
+    threads).  On this 1-core box this proves CORRECTNESS of the
+    sharded-queue design; speedups need a multi-core host
+    (BASELINE.md round-5 design note)."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import Change
+
+    def run_one(threads_):
+        nat = native_engine.NativeQhbNet(
+            10, seed=5, batch_size=BATCH_SIZE, num_faulty=0,
+            session_id=SESSION, threads=threads_,
+        )
+        keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+        keep.pop(9)
+        for nid in range(10):
+            nat.send_input(nid, Input.change(Change.node_change(keep)))
+
+        def done(e):
+            return all(
+                any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+                for i in e.correct_ids
+            )
+
+        for r in range(8):
+            if done(nat):
+                break
+            for nid in range(10):
+                nat.send_input(nid, Input.user(f"e{r}-{nid}"))
+            want = len(nat.nodes[0].outputs) + 1
+            nat.run_until(
+                lambda e, w=want: all(
+                    len(e.nodes[i].outputs) >= w for i in e.correct_ids
+                ),
+                chunk=5000,
+            )
+        assert done(nat)
+        out = {
+            "delivered": nat.delivered,
+            "eras": [nat.nodes[i].qhb.dhb.era for i in range(10)],
+            "outputs": [
+                [batch_key(b) for b in nat.nodes[i].outputs]
+                for i in range(10)
+            ],
+            "faults": [nat.faults(i) for i in range(10)],
+        }
+        nat.close()
+        return out
+
+    seq = run_one(1)
+    par = run_one(threads)
+    assert par == seq
+
+
+def test_multicore_rejects_sequential_only_modes():
+    from hbbft_tpu.crypto.bls import BLSSuite
+    from hbbft_tpu.net.adversary import ReorderingAdversary
+
+    with pytest.raises(ValueError):
+        native_engine.NativeQhbNet(4, seed=1, suite=BLSSuite(), threads=2)
+    with pytest.raises(ValueError):
+        native_engine.NativeQhbNet(
+            4, seed=1, adversary=ReorderingAdversary(), threads=2
+        )
