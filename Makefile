@@ -1,0 +1,25 @@
+# Repo-level developer targets.  The native libraries have their own
+# Makefile (native/); tests run through pytest (see CLAUDE.md for the
+# tier structure and timing expectations).
+
+PYTHON ?= python
+
+# Invariant linter (tools/lint, always available) + ruff (stock
+# pyflakes/pycodestyle/isort layer, configured in pyproject.toml) when
+# the machine has it.
+lint:
+	$(PYTHON) -m tools.lint
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check .; \
+	else \
+		echo "ruff not installed; skipped (invariant lint ran)"; \
+	fi
+
+asan ubsan tsan:
+	$(MAKE) -C native $@
+
+test-protocol:
+	$(PYTHON) -m pytest tests/ -q \
+		--ignore=tests/test_tpu_crypto.py --ignore=tests/test_jax_ops.py
+
+.PHONY: lint asan ubsan tsan test-protocol

@@ -15,7 +15,7 @@ from __future__ import annotations
 import hashlib
 import math
 from functools import lru_cache
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 from hbbft_tpu.crypto.bls import fields as F
 from hbbft_tpu.crypto.bls.fields import BLS_X, P, R, XI

@@ -12,7 +12,7 @@ Representation choices (oracle = simplicity over speed):
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 # The base-field modulus of BLS12-381 (381 bits).
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB

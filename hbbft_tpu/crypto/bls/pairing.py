@@ -18,7 +18,7 @@ by plain square-and-multiply with the integer (p^4 - p^2 + 1) / r.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 from hbbft_tpu.crypto.bls import fields as F
 from hbbft_tpu.crypto.bls.fields import BLS_X, P, R

@@ -8,7 +8,6 @@ from hbbft_tpu.crypto.bls import curve as C
 from hbbft_tpu.crypto.bls import fields as F
 from hbbft_tpu.crypto.bls import pairing as PR
 from hbbft_tpu.crypto.suite import Suite
-from hbbft_tpu.utils import canonical_bytes
 
 
 class _PointElem:

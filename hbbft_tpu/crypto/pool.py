@@ -16,7 +16,7 @@ verification results flow up the stack exactly like messages do.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Tuple
+from typing import Callable, List, Tuple
 
 from hbbft_tpu.crypto.backend import CryptoBackend, VerifyRequest
 from hbbft_tpu.protocols.traits import Step

@@ -21,7 +21,7 @@ from __future__ import annotations
 import abc
 import hashlib
 from dataclasses import dataclass
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Sequence, Tuple
 
 from hbbft_tpu.utils import canonical_bytes
 
@@ -175,6 +175,8 @@ class ScalarSuite(Suite):
         return self.is_g1(obj)
 
     def g1_from_bytes(self, data: bytes) -> ScalarG:
+        # lint: no-subgroup (prime-order scalar group: every residue in
+        # range is a member; the range check IS the membership check)
         if not isinstance(data, bytes) or len(data) != 32:
             raise ValueError("scalar group element: want 32 bytes")
         v = int.from_bytes(data, "big")

@@ -45,7 +45,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from hbbft_tpu.crypto.backend import (
-    CIPHERTEXT,
     DEC_SHARE,
     SIG_SHARE,
     BatchedBackend,

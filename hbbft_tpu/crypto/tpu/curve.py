@@ -142,6 +142,8 @@ def add_safe(ops: Ops, p: Point, q: Point) -> Point:
     both = (1 - inf1) * (1 - inf2)
     is_dbl = both * h_zero * r_zero
     is_cancel = both * h_zero * (1 - r_zero)
+    # safety: the two select()s below replace exactly the lanes where
+    # add_unsafe's P == ±Q precondition fails (is_dbl / is_cancel).
     out = add_unsafe(ops, p, q)
     out = select(is_dbl, double(ops, p), out, ops)
     out = select(is_cancel, identity(ops, tuple(inf1.shape)), out, ops)
@@ -540,6 +542,8 @@ def scalar_mul_rlc_g2(
             addend[2],
             (1 - (sbit | qbit)) | addend[3],
         )
+        # safety: MSB accumulator adds — deterministically impossible
+        # coincidence (section notes above, third bullet).
         return add_unsafe(ops, acc, addend), None
 
     acc, _ = jax.lax.scan(step, acc, xs)
@@ -575,6 +579,8 @@ def tree_sum(ops: Ops, pts: Point) -> Point:
         half = (n + 1) // 2
         top = _slice_or_identity(pts, half, n, ops)
         bottom = tuple(x[:half] for x in pts)
+        # safety: tree reduction over RLC-scaled points (module
+        # docstring, second bullet — committed-coefficient partial sums).
         pts = add_unsafe(ops, bottom, top)
         n = half
     return tuple(x[0] for x in pts)

@@ -122,12 +122,54 @@ _TAMPER_CB = ctypes.CFUNCTYPE(
 
 
 def _load(words: int) -> Optional[ctypes.CDLL]:
-    from hbbft_tpu.ops.native import build_and_load
+    # HBBFT_TPU_ENGINE_LIB: load a pre-built engine library instead of
+    # compiling engine.cpp — the sanitizer tier's hook (make asan/ubsan/
+    # tsan in native/, then point this at the produced .so; ASan/TSan
+    # also need their runtime LD_PRELOADed into the Python process).
+    # The override is width-blind: it is handed out for EVERY NodeSet
+    # width request, so only drive networks the build's -DHBE_WORDS
+    # supports (the Makefile default is 4 words = 256 nodes).
+    override = os.environ.get("HBBFT_TPU_ENGINE_LIB")
+    if override:
+        try:
+            lib = ctypes.CDLL(override)
+        except OSError as exc:
+            # An explicitly requested engine failing to load must be
+            # LOUD: silently degrading to "unavailable" makes every
+            # native test skip and hides e.g. a missing LD_PRELOAD of
+            # the sanitizer runtime (the result would also be cached).
+            raise RuntimeError(
+                f"HBBFT_TPU_ENGINE_LIB={override!r} failed to load"
+                " (sanitizer builds additionally need their runtime"
+                " LD_PRELOADed — see tests/test_sanitizers.py)"
+            ) from exc
+        # Fail fast if the pre-built library's NodeSet width cannot
+        # serve the requested network — otherwise hbe_create returns
+        # nullptr and the caller dies on a messageless assert.
+        try:
+            lib.hbe_words.restype = ctypes.c_int32
+            lib.hbe_words.argtypes = []
+        except AttributeError as exc:
+            raise RuntimeError(
+                f"HBBFT_TPU_ENGINE_LIB={override!r} exports no hbe_words"
+                " symbol: it was built from a pre-sanitizer-tier"
+                " engine.cpp — rebuild it from the current source"
+            ) from exc
+        have = int(lib.hbe_words())
+        if have < words:
+            raise RuntimeError(
+                f"HBBFT_TPU_ENGINE_LIB={override!r} was built with"
+                f" -DHBE_WORDS={have} (max {64 * have} nodes) but this"
+                f" network needs {words} words; rebuild with"
+                f" ENGINE_WORDS={words} (native/Makefile)"
+            )
+    else:
+        from hbbft_tpu.ops.native import build_and_load
 
-    lib = build_and_load(
-        _SRC, _SO_TMPL.format(w=words),
-        extra_flags=(f"-DHBE_WORDS={words}",),
-    )
+        lib = build_and_load(
+            _SRC, _SO_TMPL.format(w=words),
+            extra_flags=(f"-DHBE_WORDS={words}",),
+        )
     if lib is None:
         return None
     u8p = ctypes.POINTER(ctypes.c_uint8)

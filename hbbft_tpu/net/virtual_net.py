@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from hbbft_tpu.crypto.backend import BatchedBackend, CryptoBackend
 from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet

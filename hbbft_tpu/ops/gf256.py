@@ -16,7 +16,7 @@ the same encode/decode as int8 table-gather matmuls (ops/jax/).
 from __future__ import annotations
 
 from functools import lru_cache
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import numpy as np
 

@@ -27,7 +27,7 @@ next-era messages are buffered (bounded).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from hbbft_tpu.crypto.pool import VerifySink

@@ -18,7 +18,7 @@ for as long as it is driven (as in the reference).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from hbbft_tpu.crypto.keys import Ciphertext

@@ -10,7 +10,7 @@ changes.  Input is either a user transaction or a :class:`Change` vote.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional
 
 from hbbft_tpu.crypto.pool import VerifySink
 from hbbft_tpu.protocols.dynamic_honey_badger import (

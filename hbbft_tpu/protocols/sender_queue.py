@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage, DynamicHoneyBadger
 from hbbft_tpu.protocols.honey_badger import HbMessage, HoneyBadger
 from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
-from hbbft_tpu.protocols.traits import ConsensusProtocol, Step, Target, TargetedMessage
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 
 FAULT_MALFORMED = "sender_queue:malformed-message"
 

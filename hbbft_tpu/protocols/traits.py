@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Any, Callable, FrozenSet, Generic, Iterable, List, Optional, TypeVar
+from typing import Any, Callable, FrozenSet, Generic, Iterable, List, TypeVar
 
 from hbbft_tpu.protocols.fault_log import FaultLog
 

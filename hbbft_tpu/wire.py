@@ -38,7 +38,7 @@ cut the torsion cost ~2-4x before any batching is needed.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any
 
 from hbbft_tpu.crypto.keys import Ciphertext, PublicKey, Signature
 from hbbft_tpu.crypto.poly import BivarCommitment, Commitment

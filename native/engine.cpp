@@ -667,8 +667,8 @@ struct Td {
 // ===========================================================================
 
 struct Bcast {
-  int proposer = -1;
-  int data_shards = 0;
+  int proposer = -1;     // lint: not-reset (per-proposer config, assigned in hb_reset_state)
+  int data_shards = 0;   // lint: not-reset (per-proposer config, assigned in hb_reset_state)
   // echos / echo_hashes / readys / can_decode, with insertion order where
   // Python iterates dict insertion order (readys for Counter()).
   std::map<int, std::shared_ptr<const ProofData>> echos;
@@ -711,7 +711,7 @@ struct Bcast {
 const int MAX_FUTURE_ROUNDS = 100;
 
 struct Ba {
-  Bytes session_id;
+  Bytes session_id;  // lint: not-reset (per-epoch config, assigned in hb_reset_state)
   int round = 0;
   // Round-5 arena note: Sbv lives INLINE (value member) and Proposal
   // holds Bcast/Ba inline below, so one epoch's per-proposer protocol
@@ -804,9 +804,10 @@ struct SubsetOutItem {
 };
 
 struct EpochState {
-  int epoch = 0;
-  bool encrypted = false;
-  Bytes subset_session;
+  int epoch = 0;          // lint: not-reset (advanced by hb_reset_state's caller)
+  bool encrypted = false; // lint: not-reset (recomputed per epoch in hb_reset_state)
+  Bytes subset_session;   // lint: not-reset (recomputed per epoch in hb_reset_state)
+  // lint: not-reset (each element reset via Proposal::reset in hb_reset_state)
   std::vector<Proposal> proposals;  // indexed by proposer id
   bool subset_done = false;
   bool done_emitted = false;
@@ -3040,6 +3041,7 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     engine_unit(e, node,
                 [&](Ctx& ctx) { ctx.deliver(item.sender, *item.msg); });
     int ty = item.msg->type & 15;
+    // lint: st-only (engine_run is the sequential driver, never a worker)
     e.prof_cycles[ty] += prof_tick() - t0;
     e.prof_count[ty] += 1;
     if (!node.tampered) engine_count_unit(e);
@@ -3489,6 +3491,11 @@ void hbe_dkg_row_evals(const uint8_t* coeffs_be, int32_t n_coeffs,
   }
 }
 
+// This build's NodeSet width (for HBBFT_TPU_ENGINE_LIB overrides: the
+// loader verifies a pre-built library is wide enough for the requested
+// network instead of letting hbe_create fail opaquely).
+int32_t hbe_words() { return HBE_WORDS; }
+
 void* hbe_create(int32_t n, int32_t f) {
   // MAX_NODES = this build's NodeSet width (the loader picks a wide
   // enough build); 65535 = the GF(2^16) codec's point budget.
@@ -3637,16 +3644,19 @@ int32_t hbe_has_proposed(void* h, int32_t node) {
   return (nd.hb_init && nd.hb.state.proposed) ? 1 : 0;
 }
 
-// Current batch accessors (valid during a batch callback).
+// Current batch accessors (valid during a batch callback: the engine
+// thread holds the recursive cb_mu across batch_cb, and these are only
+// legal to call from inside that callback — same thread, lock held).
+// lint: holds-cb_mu (batch-callback context, see comment above)
 int32_t hbe_batch_size(void* h) { return (int32_t)((Engine*)h)->cur_batch.size(); }
 int32_t hbe_batch_proposer(void* h, int32_t i) {
-  return ((Engine*)h)->cur_batch[i].first;
+  return ((Engine*)h)->cur_batch[i].first;  // lint: holds-cb_mu (batch cb)
 }
 uint64_t hbe_batch_payload_len(void* h, int32_t i) {
-  return ((Engine*)h)->cur_batch[i].second->size();
+  return ((Engine*)h)->cur_batch[i].second->size();  // lint: holds-cb_mu (batch cb)
 }
 void hbe_batch_payload(void* h, int32_t i, uint8_t* out) {
-  const Bytes& b = *((Engine*)h)->cur_batch[i].second;
+  const Bytes& b = *((Engine*)h)->cur_batch[i].second;  // lint: holds-cb_mu (batch cb)
   std::memcpy(out, b.data(), b.size());
 }
 

@@ -8,17 +8,15 @@ validator set signs/decrypts with the NEW threshold keys.
 
 import random
 
-import pytest
 
 from hbbft_tpu.crypto.keys import SecretKey
 from hbbft_tpu.crypto.suite import ScalarSuite
-from hbbft_tpu.net import NetBuilder, NullAdversary, ReorderingAdversary
+from hbbft_tpu.net import NetBuilder, ReorderingAdversary
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     Change,
     ChangeState,
     DhbBatch,
     DynamicHoneyBadger,
-    JoinPlan,
 )
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
 

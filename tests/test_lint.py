@@ -1,0 +1,533 @@
+"""Tests for the invariant linter (tools/lint): one violating and one
+clean fixture per rule, plus the whole-repo "HEAD is clean" gate.
+
+The fixtures are source STRINGS fed through the same entry points the
+CLI uses (pylints.lint_files / cxxlints.lint_source), so rule behavior
+is pinned without touching disk; paths are virtual but repo-shaped
+(several rules scope by path).
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from tools.lint import run_all
+from tools.lint.cxxlints import lint_source
+from tools.lint.pylints import lint_files
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def py_findings(src, path="hbbft_tpu/crypto/tpu/curve.py"):
+    return lint_files({path: src})
+
+
+# ---------------------------------------------------------------------------
+# HBT001: add_unsafe safety annotations
+# ---------------------------------------------------------------------------
+
+HBT001_BAD = """
+def caller(ops, p, q):
+    return add_unsafe(ops, p, q)
+"""
+
+HBT001_COMMENT_OK = """
+def caller(ops, p, q):
+    # safety: inputs are distinct by construction (test fixture)
+    return add_unsafe(ops, p, q)
+"""
+
+HBT001_DOCSTRING_OK = '''
+def caller(ops, p, q):
+    """Sum two points.
+
+    add_unsafe safety: the caller guarantees p != ±q.
+    """
+    return add_unsafe(ops, p, q)
+'''
+
+
+def test_add_unsafe_without_annotation_flagged():
+    assert "HBT001" in rules_of(py_findings(HBT001_BAD))
+
+
+def test_add_unsafe_comment_annotation_passes():
+    assert "HBT001" not in rules_of(py_findings(HBT001_COMMENT_OK))
+
+
+def test_add_unsafe_docstring_annotation_passes():
+    assert "HBT001" not in rules_of(py_findings(HBT001_DOCSTRING_OK))
+
+
+def test_add_unsafe_rule_scoped_to_tpu_tree():
+    # The same call outside crypto/tpu/ (e.g. the host oracle) is not
+    # this rule's business.
+    f = py_findings(HBT001_BAD, path="hbbft_tpu/crypto/bls/curve.py")
+    assert "HBT001" not in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
+# HBT002: Step reuse after map_messages
+# ---------------------------------------------------------------------------
+
+HBT002_BAD = """
+def lift(child_step, wrap):
+    step = child_step.map_messages(wrap)
+    return child_step.output
+"""
+
+HBT002_OK = """
+def lift(child_step, wrap):
+    step = child_step.map_messages(wrap)
+    outputs, step.output = step.output, []
+    return step
+"""
+
+HBT002_REBIND_OK = """
+def lift(child_step, wrap, fresh):
+    step = child_step.map_messages(wrap)
+    child_step = fresh()
+    return child_step.output
+"""
+
+
+def test_step_reuse_flagged():
+    f = py_findings(HBT002_BAD, path="hbbft_tpu/protocols/subset.py")
+    assert "HBT002" in rules_of(f)
+
+
+def test_step_no_reuse_passes():
+    f = py_findings(HBT002_OK, path="hbbft_tpu/protocols/subset.py")
+    assert "HBT002" not in rules_of(f)
+
+
+def test_step_rebound_name_passes():
+    f = py_findings(HBT002_REBIND_OK, path="hbbft_tpu/protocols/subset.py")
+    assert "HBT002" not in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
+# HBT003: jit of interpret-mode pallas_call
+# ---------------------------------------------------------------------------
+
+HBT003_BAD = """
+import jax
+import jax.experimental.pallas as pl
+
+def kernel_host(x, interpret):
+    return pl.pallas_call(_body, out_shape=x, interpret=interpret)(x)
+
+kernel_jit = jax.jit(kernel_host)
+"""
+
+HBT003_PARTIAL_BAD = """
+import functools, jax
+import jax.experimental.pallas as pl
+
+def kernel_host(x, interpret):
+    return pl.pallas_call(_body, out_shape=x, interpret=interpret)(x)
+
+kernel_jit = jax.jit(functools.partial(kernel_host, interpret=True))
+"""
+
+HBT003_OK = """
+import functools, jax
+import jax.experimental.pallas as pl
+
+def kernel_host(x, interpret):
+    return pl.pallas_call(_body, out_shape=x, interpret=interpret)(x)
+
+kernel_jit = jax.jit(functools.partial(kernel_host, interpret=False))
+"""
+
+
+def test_jit_of_interpret_capable_flagged():
+    f = py_findings(HBT003_BAD, path="hbbft_tpu/ops/jaxops/k.py")
+    assert "HBT003" in rules_of(f)
+
+
+def test_jit_of_partial_interpret_true_flagged():
+    f = py_findings(HBT003_PARTIAL_BAD, path="hbbft_tpu/ops/jaxops/k.py")
+    assert "HBT003" in rules_of(f)
+
+
+def test_jit_of_partial_pinned_false_passes():
+    f = py_findings(HBT003_OK, path="hbbft_tpu/ops/jaxops/k.py")
+    assert "HBT003" not in rules_of(f)
+
+
+def test_partial_jit_decorator_flagged():
+    # @partial(jax.jit, ...) is the standard options-carrying jit idiom.
+    src = """
+from functools import partial
+import jax
+import jax.experimental.pallas as pl
+
+@partial(jax.jit, static_argnums=(1,))
+def kernel_host(x, interpret):
+    return pl.pallas_call(_body, out_shape=x, interpret=interpret)(x)
+"""
+    f = py_findings(src, path="hbbft_tpu/ops/jaxops/k.py")
+    assert "HBT003" in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
+# HBT004: cross-scan accumulator chains
+# ---------------------------------------------------------------------------
+
+HBT004_BAD = """
+import jax
+
+def bad(segments, base, chain0):
+    chain = chain0
+    carry = base
+    for seg in segments:
+        carry, _ = jax.lax.scan(step, carry, seg)
+        chain = add_unsafe(ops, chain, carry)  # safety: fixture
+    return chain
+"""
+
+HBT004_OK_CARRY = """
+import jax
+
+def good(segments, base):
+    carry = base
+    for seg in segments:
+        carry, _ = jax.lax.scan(step, carry, seg)
+        carry = mul(carry, base)
+    return carry
+"""
+
+HBT004_OK_COLLECT = """
+import jax
+
+def good(segments, base):
+    carry = base
+    curs = []
+    for seg in segments:
+        carry, _ = jax.lax.scan(step, carry, seg)
+        curs.append(carry)
+    return tree_sum(curs)
+"""
+
+
+def test_cross_scan_accumulator_flagged():
+    f = py_findings(HBT004_BAD, path="hbbft_tpu/crypto/tpu/x.py")
+    assert "HBT004" in rules_of(f)
+
+
+def test_scan_carry_update_passes():
+    # pow_x_abs / miller_loop shape: the updated name IS the scan carry.
+    f = py_findings(HBT004_OK_CARRY, path="hbbft_tpu/crypto/tpu/x.py")
+    assert "HBT004" not in rules_of(f)
+
+
+def test_collect_then_reduce_passes():
+    # The documented fix: collect per-segment values, reduce after.
+    f = py_findings(HBT004_OK_COLLECT, path="hbbft_tpu/crypto/tpu/x.py")
+    assert "HBT004" not in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
+# HBT005: subgroup-check reachability
+# ---------------------------------------------------------------------------
+
+HBT005_SUITE_BAD = """
+class LeakySuite:
+    def g1_from_bytes(self, data):
+        return G1Elem(decode(data))
+"""
+
+HBT005_SUITE_OK = """
+class SafeSuite:
+    def g1_from_bytes(self, data):
+        elem = G1Elem(decode(data))
+        if not self.is_g1(elem):
+            raise ValueError("bad point")
+        return elem
+"""
+
+HBT005_WIRE_BAD = """
+def _unpack_ciphertext(f):
+    name, u, v, w = f
+    return Ciphertext(u, v, w, get_suite(name))
+
+register_struct("ct", Ciphertext, _pack_ciphertext, _unpack_ciphertext)
+"""
+
+HBT005_WIRE_OK = """
+def _g1(suite, v, what):
+    return v
+
+def _unpack_ciphertext(f):
+    name, u, v, w = f
+    suite = get_suite(name)
+    return Ciphertext(_g1(suite, u, "u"), v, w, suite)
+
+register_struct("ct", Ciphertext, _pack_ciphertext, _unpack_ciphertext)
+"""
+
+HBT005_WIRE_UNKNOWN_TAG = """
+def _unpack_widget(f):
+    return Widget(*f)
+
+register_struct("widget", Widget, _pack_widget, _unpack_widget)
+"""
+
+
+def test_from_bytes_without_check_flagged():
+    f = py_findings(HBT005_SUITE_BAD, path="hbbft_tpu/crypto/suite.py")
+    assert "HBT005" in rules_of(f)
+
+
+def test_from_bytes_checked_in_any_module_path():
+    # The entry-point rule follows the definition wherever it lives — a
+    # future suite in a new module is not exempt by its path.
+    f = py_findings(
+        HBT005_SUITE_BAD, path="hbbft_tpu/crypto/edwards/suite.py"
+    )
+    assert "HBT005" in rules_of(f)
+
+
+def test_from_bytes_with_check_passes():
+    f = py_findings(HBT005_SUITE_OK, path="hbbft_tpu/crypto/suite.py")
+    assert "HBT005" not in rules_of(f)
+
+
+def test_point_unpacker_without_check_flagged():
+    f = py_findings(HBT005_WIRE_BAD, path="hbbft_tpu/wire.py")
+    assert "HBT005" in rules_of(f)
+
+
+def test_point_unpacker_with_funnel_passes():
+    f = py_findings(HBT005_WIRE_OK, path="hbbft_tpu/wire.py")
+    assert "HBT005" not in rules_of(f)
+
+
+def test_unclassified_struct_tag_flagged():
+    f = py_findings(HBT005_WIRE_UNKNOWN_TAG, path="hbbft_tpu/wire.py")
+    assert "HBT005" in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
+# HBC001: C++ field resets (fixture structs + patched real source)
+# ---------------------------------------------------------------------------
+
+CXX_FIXTURE = """
+struct Sbv {
+  int n = 0;
+  bool aux_sent = false;
+};
+
+struct Ba {
+  int round = 0;
+  Sbv sbv;
+};
+
+struct Proposal {
+  Ba ba;
+  int decision = -1;
+  bool emitted = false;
+  int forgotten = 0;
+
+  void reset() {
+    ba.round = 0;
+    ba.sbv = Sbv();
+    decision = -1;
+    emitted = false;
+  }
+};
+
+struct EpochState {
+  int epoch = 0;  // lint: not-reset (advanced by caller)
+  bool subset_done = false;
+  void reset_for_epoch() {
+    subset_done = false;
+  }
+};
+"""
+
+
+def test_cxx_unreset_field_flagged():
+    f = [x for x in lint_source(CXX_FIXTURE, "fixture.cpp") if x.rule == "HBC001"]
+    assert len(f) == 1 and "'forgotten'" in f[0].message
+
+
+def test_cxx_fixture_clean_when_reset():
+    fixed = CXX_FIXTURE.replace("emitted = false;\n  }", "emitted = false;\n    forgotten = 0;\n  }")
+    f = [x for x in lint_source(fixed, "fixture.cpp") if x.rule == "HBC001"]
+    assert f == []
+
+
+def test_cxx_nested_field_requires_reset():
+    # Remove the whole-object sbv reset: Sbv's fields must then be
+    # reset one by one via ba.sbv.<field>.
+    broken = CXX_FIXTURE.replace("    ba.sbv = Sbv();\n", "")
+    broken = broken.replace("int forgotten = 0;\n", "")
+    f = [x for x in lint_source(broken, "fixture.cpp") if x.rule == "HBC001"]
+    assert any("ba.sbv." in x.message for x in f)
+
+
+def test_cxx_container_of_reset_structs_flagged(engine_src):
+    # A container holding reset-tracked structs cannot be verified
+    # per-element: it must be annotated, never silently passed.
+    patched = engine_src.replace(
+        "struct Proposal {", "struct Proposal {\n  std::array<Ba, 2> spares;"
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC001"]
+    assert any("spares" in x.message for x in f)
+
+
+def test_cxx_engine_alias_does_not_evade_prof_rule():
+    # The engine reference may be named anything; a renamed parameter
+    # must not disable the single-writer check (or its guard).
+    bad = "void f(Engine& eng) {\n  eng.prof_count[14] += 1;\n}\n"
+    f = [x for x in lint_source(bad, "f.cpp") if x.rule == "HBC002"]
+    assert len(f) == 1
+    ok = (
+        "void f(Engine& eng) {\n  if (!eng.mt_active) {\n"
+        "    eng.prof_count[14] += 1;\n  }\n}\n"
+    )
+    f = [x for x in lint_source(ok, "f.cpp") if x.rule == "HBC002"]
+    assert f == []
+
+
+def test_cxx_braceless_guard_covers_only_its_statement():
+    # A braceless '!mt_active' guard must cover exactly its own
+    # statement — not an unrelated block opening on the next line.
+    fixture = """
+void g(Engine& e) {
+  if (!e.mt_active) e.prof_count[14]++;
+  for (int i = 0; i < 3; ++i) {
+    e.prof_cycles[13] += 1;
+  }
+}
+"""
+    f = [x for x in lint_source(fixture, "fixture.cpp") if x.rule == "HBC002"]
+    assert len(f) == 1 and f[0].line == 5
+
+
+def test_cxx_guard_brace_styles_all_recognized():
+    for form in (
+        "if (!e.mt_active) {\n    e.prof_count[14]++;\n  }",
+        "if (!e.mt_active)\n  {\n    e.prof_count[14]++;\n  }",
+        "if (!e.mt_active)\n    e.prof_count[14]++;",
+        "if (!e.mt_active) e.prof_count[14]++;",
+    ):
+        src = "void g(Engine& e) {\n  %s\n}\n" % form
+        f = [x for x in lint_source(src, "f.cpp") if x.rule == "HBC002"]
+        assert f == [], (form, [x.render() for x in f])
+
+
+def test_cxx_not_reset_annotation_does_not_leak_to_neighbor():
+    # An inline '// lint: not-reset' trailer on one field must not
+    # exempt the NEXT declaration from the reset check.
+    fixture = """
+struct Proposal {
+  int cfg = 0;  // lint: not-reset (assigned at epoch open)
+  int forgotten = 0;
+  void reset() {}
+};
+struct EpochState {
+  int x = 0;
+  void reset_for_epoch() { x = 0; }
+};
+"""
+    f = [x for x in lint_source(fixture, "fixture.cpp") if x.rule == "HBC001"]
+    assert len(f) == 1 and "'forgotten'" in f[0].message
+
+
+def test_cxx_stale_slot_claims_only_checked_on_engine_source():
+    # Fixtures/partial sources legitimately omit claimed slots; only the
+    # real engine.cpp is the registry's ground truth.
+    f = [x for x in lint_source(CXX_FIXTURE, "fixture.cpp") if x.rule == "HBC004"]
+    assert f == []
+
+
+@pytest.fixture(scope="module")
+def engine_src():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "native", "engine.cpp")) as fh:
+        return fh.read()
+
+
+def test_engine_patched_unreset_proposal_field_flagged(engine_src):
+    # The acceptance demonstration: a deliberately added mutable
+    # Proposal field with no reset fails lint on the REAL source.
+    patched = engine_src.replace(
+        "struct Proposal {", "struct Proposal {\n  int sneaky_counter = 0;"
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC001"]
+    assert any("sneaky_counter" in x.message for x in f)
+
+
+def test_engine_patched_free_slot_write_flagged(engine_src):
+    patched = engine_src.replace(
+        "e.prof_count[14]++;",
+        "e.prof_count[14]++;\n        e.prof_cycles[12] += dt;",
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC004"]
+    assert any("slot 12" in x.message for x in f)
+
+
+def test_engine_patched_unguarded_prof_write_flagged(engine_src):
+    # A stamp added OUTSIDE the !mt_active guard (e.g. in pending_run,
+    # which workers reach) must fail HBC002.
+    patched = engine_src.replace(
+        "void pending_run(Engine& e, Node& node, Pending& p, bool ok) {",
+        "void pending_run(Engine& e, Node& node, Pending& p, bool ok) {\n"
+        "  e.prof_count[13]++;",
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC002"]
+    assert len(f) == 1
+
+
+def test_engine_patched_unlocked_cache_access_flagged(engine_src):
+    patched = engine_src.replace(
+        "void pending_run(Engine& e, Node& node, Pending& p, bool ok) {",
+        "void pending_run(Engine& e, Node& node, Pending& p, bool ok) {\n"
+        "  e.decoded_roots.clear();",
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC003"]
+    assert any("decoded_roots" in x.message for x in f)
+
+
+# ---------------------------------------------------------------------------
+# Whole-repo gates
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    assert run_all() == []
+
+
+def test_cli_exit_codes(tmp_path):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo}
+    # Clean repo -> 0; a violating fixture file -> nonzero.
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.lint"],
+        capture_output=True,
+        cwd=repo,
+        env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    # Path-scoped rules key off the path, so give the fixture a
+    # crypto/tpu/-shaped location.
+    target = tmp_path / "hbbft_tpu" / "crypto" / "tpu"
+    target.mkdir(parents=True)
+    (target / "fixture.py").write_text(HBT001_BAD)
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.lint", str(target / "fixture.py")],
+        capture_output=True,
+        cwd=repo,
+        env=env,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr

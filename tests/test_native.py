@@ -8,7 +8,6 @@ since Broadcast mixes both paths freely.
 """
 
 import hashlib
-import os
 import random
 
 import numpy as np

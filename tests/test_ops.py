@@ -6,8 +6,6 @@ import numpy as np
 import pytest
 
 from hbbft_tpu.ops.gf256 import (
-    EXP,
-    LOG,
     ReedSolomon,
     encoding_matrix,
     gf_inv,

@@ -8,7 +8,7 @@ the sender-queue epoch-gating semantics of ``src/sender_queue/``.
 from hbbft_tpu.net import NetBuilder, ReorderingAdversary
 from hbbft_tpu.protocols.dynamic_honey_badger import Change, DhbBatch
 from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
-from hbbft_tpu.protocols.sender_queue import SenderQueue, SqMessage
+from hbbft_tpu.protocols.sender_queue import SenderQueue
 
 
 def build_qhb_net(n=4, seed=0, batch_size=8, adversary=None, sender_queue=False, f=0):

@@ -1,0 +1,146 @@
+"""Sanitizer tier: the native engine under ASan/UBSan/TSan.
+
+``make -C native asan|ubsan|tsan`` builds instrumented engine libraries;
+``HBBFT_TPU_ENGINE_LIB`` (hbbft_tpu/native_engine.py) loads them in place
+of the normal build.  Python itself is not instrumented, so the
+sanitizer runtime must be LD_PRELOADed into the subprocess; each test
+therefore drives a fresh interpreter rather than loading the lib here.
+
+The driven workload is the small-N native epoch of the equivalence
+suites (ASan/UBSan, default tier) and an ``engine_run_mt`` multi-thread
+epoch (TSan, slow tier — the multicore worker rules in CLAUDE.md are
+exactly what TSan checks mechanically).  The driver never imports jax:
+the protocol plane is pure Python + the C++ engine, which keeps the
+sanitized process small and the reports clean.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="no C++ toolchain",
+)
+
+# One complete epoch at N=4 (one silent-faulty by default), asserting
+# the correct nodes commit identical batch sequences — a miniature of
+# tests/test_native_engine.py's fidelity contract, run for the
+# sanitizer's benefit rather than for protocol coverage.
+DRIVER = """
+import sys
+from hbbft_tpu import native_engine
+assert native_engine.available(), "sanitized engine failed to load"
+threads = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+kw = {"threads": threads} if threads else {}
+nat = native_engine.NativeQhbNet(
+    4, seed=1, batch_size=3, session_id=b"sanitizer", **kw
+)
+for i in range(4):
+    nat.send_input(i, ("tx", i))
+# chunk must batch MANY deliveries per engine call in threaded mode:
+# engine_run_mt takes one generation per call of at most `chunk` queue
+# items, and a generation with a single destination runs inline on the
+# calling thread — chunk=1 would make the TSan run single-threaded and
+# vacuous.  256 yields multi-destination generations (real worker
+# threads) and the predicate still stops us within one chunk of the
+# first batch (no QHB empty-epoch runaway).
+nat.run_until(
+    lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+    chunk=1 if threads == 0 else 256,
+)
+keys = [
+    [(b.era, b.epoch, b.contributions) for b in nat.nodes[i].outputs[:1]]
+    for i in nat.correct_ids
+]
+assert all(k == keys[0] for k in keys), "correct nodes diverged"
+print("SANITIZED-EPOCH-OK")
+"""
+
+
+def _runtime(name: str) -> str:
+    """Full path of the sanitizer runtime g++ links against."""
+    out = subprocess.run(
+        ["g++", f"-print-file-name={name}"],
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout.strip()
+    if not os.path.isabs(out) or not os.path.exists(out):
+        pytest.skip(f"{name} runtime not installed")
+    return out
+
+
+def _build(target: str) -> str:
+    res = subprocess.run(
+        ["make", "-C", NATIVE, target],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert res.returncode == 0, f"make {target} failed:\n{res.stderr[-4000:]}"
+    lib = os.path.join(NATIVE, "build", f"libhbbft_engine_{target}.so")
+    assert os.path.exists(lib)
+    return lib
+
+
+def _drive(lib: str, preload: str, extra_env: dict, threads: int = 0):
+    env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        # Drop the axon sitecustomize (CLAUDE.md env gotchas): the
+        # driver has no jax dependency and the TPU relay must not be
+        # touched from a sanitized process.
+        "PYTHONPATH": REPO,
+        "HBBFT_TPU_ENGINE_LIB": lib,
+        "LD_PRELOAD": preload,
+        **extra_env,
+    }
+    cmd = [sys.executable, "-c", DRIVER]
+    if threads:
+        cmd.append(str(threads))
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=600
+    )
+
+
+def test_asan_native_epoch():
+    lib = _build("asan")
+    res = _drive(
+        lib,
+        _runtime("libasan.so"),
+        # Python's own allocations "leak" by ASan's lights; the engine
+        # checks we care about are heap misuse, not the interpreter's
+        # exit-time bookkeeping.
+        {"ASAN_OPTIONS": "detect_leaks=0"},
+    )
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "AddressSanitizer" not in res.stderr
+
+
+def test_ubsan_native_epoch():
+    lib = _build("ubsan")
+    res = _drive(lib, _runtime("libubsan.so"), {})
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "runtime error" not in res.stderr
+
+
+@pytest.mark.slow
+def test_tsan_multithread_epoch():
+    lib = _build("tsan")
+    res = _drive(
+        lib,
+        _runtime("libtsan.so"),
+        {"TSAN_OPTIONS": "report_thread_leaks=0"},
+        threads=2,
+    )
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "WARNING: ThreadSanitizer" not in res.stderr

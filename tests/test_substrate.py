@@ -1,8 +1,7 @@
 """Substrate unit tests: Target routing, Step algebra, NetworkInfo sizes."""
 
-from hbbft_tpu.protocols.fault_log import FaultLog
 from hbbft_tpu.protocols.network_info import NetworkInfo
-from hbbft_tpu.protocols.traits import Step, Target, TargetedMessage
+from hbbft_tpu.protocols.traits import Step, Target
 
 
 def test_target_expansion():

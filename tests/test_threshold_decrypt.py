@@ -6,7 +6,7 @@ plus ``src/threshold_decrypt.rs`` unit behavior.
 
 import random
 
-from hbbft_tpu.crypto.keys import Ciphertext, SecretKeySet
+from hbbft_tpu.crypto.keys import Ciphertext
 from hbbft_tpu.crypto.suite import ScalarSuite
 from hbbft_tpu.net import NetBuilder, ReorderingAdversary
 from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt

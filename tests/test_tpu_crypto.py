@@ -18,7 +18,6 @@ import jax.numpy as jnp
 
 from hbbft_tpu.crypto.bls import curve as oc
 from hbbft_tpu.crypto.bls import fields as OF
-from hbbft_tpu.crypto.bls import pairing as op
 from hbbft_tpu.crypto.bls.suite import BLSSuite
 from hbbft_tpu.crypto.tpu import curve as dc
 from hbbft_tpu.crypto.tpu import fq, fq2

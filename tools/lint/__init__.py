@@ -1,0 +1,118 @@
+"""Invariant linter: machine-checks for the hand-maintained safety rules.
+
+The protocol stack and native engine carry correctness rules that no
+stock tool enforces (CLAUDE.md "Design invariants worth not breaking"
+and the perf-state notes): ``add_unsafe`` call sites need a written
+safety argument, every mutable ``Proposal``/``EpochState`` field must be
+restored by the in-place resets, profiling counters are single-writer
+under ``engine_run_mt``, interpret-mode ``pallas_call`` must never be
+jitted, cross-``lax.scan`` accumulator chains crash XLA 0.9.0, and
+wire-sourced group elements must reach a subgroup check.  This package
+turns each of those prose invariants into a lint rule:
+
+* :mod:`tools.lint.pylints` — Python AST rules (HBT0xx) over
+  ``hbbft_tpu/``.
+* :mod:`tools.lint.cxxlints` — lightweight structural rules (HBC0xx)
+  over ``native/engine.cpp`` (no libclang on this box; the checks are
+  regex/brace-tracking over comment-stripped source).
+* :mod:`tools.lint.slot_registry` — the free/claimed profiling-slot
+  registry HBC004 enforces.
+
+Run ``python -m tools.lint`` from the repo root; exit status is nonzero
+iff findings exist.  Each rule and its annotation escapes are documented
+in docs/INVARIANTS.md.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation: rule id, file, 1-based line, message."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def default_python_files() -> Dict[str, str]:
+    """path -> source for every tracked .py file under hbbft_tpu/."""
+    out: Dict[str, str] = {}
+    root = os.path.join(_REPO, "hbbft_tpu")
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                out[os.path.relpath(path, _REPO)] = f.read()
+    return out
+
+
+def expand_paths(paths: List[str]) -> tuple[List[str], List[tuple[str, str]]]:
+    """(lintable_files, skipped) for explicit CLI paths.
+
+    Directories are walked for .py files and engine.cpp; anything the
+    rules cannot apply to — or that does not exist — lands in
+    ``skipped`` as (path, reason) so the caller can refuse to bless it
+    silently.  The C++ rules encode engine.cpp-specific structure
+    (Proposal/EpochState, the slot registry), so other C++ files have
+    nothing for them to check.
+    """
+    files: List[str] = []
+    skipped: List[tuple[str, str]] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(_REPO, p)
+        if os.path.isdir(ap):
+            found = False
+            for dirpath, _dirnames, filenames in os.walk(ap):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py") or fn == "engine.cpp":
+                        files.append(os.path.join(dirpath, fn))
+                        found = True
+            if not found:
+                skipped.append((p, "no lintable files in directory"))
+        elif not os.path.exists(ap):
+            skipped.append((p, "not found"))
+        elif ap.endswith(".py") or os.path.basename(ap) == "engine.cpp":
+            files.append(ap)
+        else:
+            skipped.append((p, "no rules for this file"))
+    return files, skipped
+
+
+def run_all(paths: List[str] | None = None) -> List[Finding]:
+    """Lint the repo (or just ``paths``); returns all findings.
+    Explicit paths are expanded via :func:`expand_paths` (files no rule
+    applies to are dropped — CLI callers surface those as skipped)."""
+    from tools.lint import cxxlints, pylints
+
+    findings: List[Finding] = []
+    if paths:
+        py: Dict[str, str] = {}
+        files, _skipped = expand_paths(paths)
+        for ap in files:
+            rel = os.path.relpath(ap, _REPO)
+            with open(ap, "r", encoding="utf-8") as f:
+                src = f.read()
+            if ap.endswith(".py"):
+                py[rel] = src
+            else:
+                findings.extend(cxxlints.lint_source(src, rel))
+        findings.extend(pylints.lint_files(py))
+    else:
+        findings.extend(pylints.lint_files(default_python_files()))
+        engine = os.path.join(_REPO, "native", "engine.cpp")
+        with open(engine, "r", encoding="utf-8") as f:
+            findings.extend(cxxlints.lint_source(f.read(), "native/engine.cpp"))
+    return sorted(findings, key=lambda x: (x.path, x.line, x.rule))

@@ -1,0 +1,50 @@
+"""CLI: ``python -m tools.lint [paths...]``.
+
+No arguments lints the default surface (hbbft_tpu/**/*.py +
+native/engine.cpp).  Explicit paths lint just those files (rules still
+scope by path, so fixture files must carry repo-shaped names); files no
+rule applies to are reported as skipped, never silently blessed.  Exit
+status 1 iff findings exist.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tools.lint import expand_paths, run_all
+
+
+def main(argv: list[str]) -> int:
+    flags = [a for a in argv if a.startswith("-")]
+    if flags:
+        print(
+            f"tools.lint: unknown option(s) {flags} (usage:"
+            " python -m tools.lint [paths...])",
+            file=sys.stderr,
+        )
+        return 2
+    if argv:
+        files, skipped = expand_paths(argv)
+        for p, reason in skipped:
+            print(
+                f"tools.lint: skipped {p} ({reason} — NOT checked)",
+                file=sys.stderr,
+            )
+        if not files:
+            print(
+                "tools.lint: nothing lintable in the given paths",
+                file=sys.stderr,
+            )
+            return 2
+    findings = run_all(argv or None)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("tools.lint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,671 @@
+"""Structural lint rules for native/engine.cpp (no libclang on this box).
+
+The checks parse comment-stripped source with brace tracking — enough
+structure for field lists, block extents, and guard scopes, which is all
+these invariants need:
+
+* HBC001 — every mutable field of ``Proposal``/``EpochState`` (and the
+  nested ``Bcast``/``Ba``/``Sbv`` state) is restored by
+  ``Proposal::reset`` / ``EpochState::reset_for_epoch``.  A missed field
+  is cross-epoch contamination (the reset-in-place recycling relies on
+  the resets being exhaustive; CLAUDE.md round-5 notes).  Intentionally
+  persistent fields carry a ``// lint: not-reset (<why>)`` annotation on
+  their declaration.
+* HBC002 — profiling-counter writes are single-writer: each literal
+  ``prof_cycles``/``prof_count`` write sits under an ``if
+  (!e.mt_active))`` guard or in code annotated ``// lint: st-only``.
+* HBC003 — worker-shared state (``decoded_roots``/``decoded_order``,
+  ``mask_by_acc``/``mask_order`` under ``cache_mu``; ``cur_batch`` under
+  ``cb_mu``) is only touched inside a matching ``std::lock_guard`` block
+  or code annotated ``// lint: holds-<mutex>`` / ``// lint: st-only``.
+* HBC004 — literal profiling-slot indices must be claimed in
+  :mod:`tools.lint.slot_registry`; FREE slots fail lint until claimed,
+  stale claims fail lint until released.
+
+Annotations apply to their own line or the two lines above the use —
+close enough that a reviewer sees claim and use together.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.lint import Finding
+from tools.lint.slot_registry import CLAIMED_SLOTS, FREE_SLOTS, TYPED_DELIVERY_SLOTS
+
+# Structs whose reset exhaustiveness is checked, with their reset method
+# (None = reset via the parent that embeds them).
+RESET_STRUCTS = ("Sbv", "Bcast", "Ba", "Proposal", "EpochState")
+RESET_METHODS = {"Proposal": "reset", "EpochState": "reset_for_epoch"}
+
+MUTEX_FOR = {
+    "decoded_roots": "cache_mu",
+    "decoded_order": "cache_mu",
+    "mask_by_acc": "cache_mu",
+    "mask_order": "cache_mu",
+    "cur_batch": "cb_mu",
+}
+
+NOT_RESET_RE = re.compile(r"lint:\s*not-reset")
+ST_ONLY_RE = re.compile(r"lint:\s*st-only")
+HOLDS_RE = re.compile(r"lint:\s*holds-(\w+)")
+
+
+# ---------------------------------------------------------------------------
+# Lightweight C++ preprocessing
+# ---------------------------------------------------------------------------
+
+
+def _strip(src: str) -> Tuple[List[str], List[str]]:
+    """(code_lines, raw_lines): code has //, /* */ comments and string/char
+    literals blanked (same length per line, so columns/regexes line up)."""
+    raw_lines = src.splitlines()
+    out: List[str] = []
+    in_block = False
+    for line in raw_lines:
+        buf = []
+        i = 0
+        n = len(line)
+        in_str: Optional[str] = None
+        while i < n:
+            c = line[i]
+            if in_block:
+                if c == "*" and i + 1 < n and line[i + 1] == "/":
+                    in_block = False
+                    buf.append("  ")
+                    i += 2
+                    continue
+                buf.append(" ")
+                i += 1
+                continue
+            if in_str:
+                if c == "\\" and i + 1 < n:
+                    buf.append("  ")
+                    i += 2
+                    continue
+                if c == in_str:
+                    in_str = None
+                    buf.append(c)
+                else:
+                    buf.append(" ")
+                i += 1
+                continue
+            if c == "/" and i + 1 < n and line[i + 1] == "/":
+                break
+            if c == "/" and i + 1 < n and line[i + 1] == "*":
+                in_block = True
+                buf.append("  ")
+                i += 2
+                continue
+            if c in "\"'":
+                in_str = c
+                buf.append(c)
+                i += 1
+                continue
+            buf.append(c)
+            i += 1
+        out.append("".join(buf))
+    return out, raw_lines
+
+
+class _Blocks:
+    """Brace intervals: for each '{', its (open_line, close_line), 1-based."""
+
+    def __init__(self, code_lines: List[str]) -> None:
+        self.intervals: List[Tuple[int, int]] = []
+        stack: List[int] = []
+        for ln, line in enumerate(code_lines, 1):
+            for c in line:
+                if c == "{":
+                    stack.append(ln)
+                elif c == "}":
+                    if stack:
+                        self.intervals.append((stack.pop(), ln))
+        # Unclosed braces: treat as extending to EOF.
+        for open_ln in stack:
+            self.intervals.append((open_ln, len(code_lines)))
+        self.intervals.sort()
+
+    def innermost_containing(self, line: int) -> Optional[Tuple[int, int]]:
+        best = None
+        for o, c in self.intervals:
+            if o <= line <= c and (
+                best is None or (o >= best[0] and c <= best[1])
+            ):
+                best = (o, c)
+        return best
+
+    def block_opening_at(self, line: int) -> Optional[Tuple[int, int]]:
+        """The block whose '{' is on ``line`` or the next line (guard/if
+        bodies)."""
+        cands = [iv for iv in self.intervals if iv[0] in (line, line + 1)]
+        if not cands:
+            return None
+        return max(cands, key=lambda iv: iv[0] * 100000 - iv[1])
+
+
+def _annotated(raw_lines: List[str], line: int, regex: re.Pattern) -> bool:
+    lo = max(line - 2, 1)
+    return any(regex.search(raw_lines[i - 1]) for i in range(lo, line + 1))
+
+
+def _not_reset_annotated(raw_lines: List[str], line: int) -> bool:
+    """not-reset applies only to the declaration's own line or
+    comment-ONLY lines immediately above it — an inline trailer on the
+    PREVIOUS field must not leak onto this one (that would silently
+    exempt its neighbor from the reset check)."""
+    if NOT_RESET_RE.search(raw_lines[line - 1]):
+        return True
+    i = line - 1  # 1-based line above the declaration
+    while i >= 1 and raw_lines[i - 1].strip().startswith("//"):
+        if NOT_RESET_RE.search(raw_lines[i - 1]):
+            return True
+        i -= 1
+    return False
+
+
+def _find_struct_body(
+    code_lines: List[str], name: str
+) -> Optional[Tuple[int, int]]:
+    """(body_open_line, body_close_line) of ``struct <name> {``."""
+    pat = re.compile(rf"\bstruct\s+{name}\s*{{")
+    blocks = _Blocks(code_lines)
+    for ln, line in enumerate(code_lines, 1):
+        if pat.search(line):
+            iv = blocks.block_opening_at(ln)
+            if iv:
+                return iv
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Struct field extraction
+# ---------------------------------------------------------------------------
+
+_CXX_KEYWORDS = {
+    "public", "private", "protected", "using", "typedef", "friend",
+    "static", "constexpr", "enum",
+}
+
+
+def _split_top_commas(s: str) -> List[str]:
+    parts, depth, cur = [], 0, []
+    for c in s:
+        if c in "<([{":
+            depth += 1
+        elif c in ">)]}":
+            depth -= 1
+        if c == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    parts.append("".join(cur))
+    return parts
+
+
+def _field_name(declarator: str) -> Optional[str]:
+    d = declarator.split("=", 1)[0]
+    d = d.split("[", 1)[0]
+    idents = re.findall(r"[A-Za-z_]\w*", d)
+    if not idents:
+        return None
+    name = idents[-1]
+    if name in _CXX_KEYWORDS:
+        return None
+    return name
+
+
+def _type_of(statement: str, first_field: str) -> str:
+    """The full type text before the first declarator name ('Bcast bc'
+    -> 'Bcast'; 'std::map<int, Root> x' -> 'std::map<int, Root>') — the
+    reset checker classifies it by its identifiers (a template holding a
+    tracked struct must not slip past the nested-reset check)."""
+    m = re.search(rf"\b{re.escape(first_field)}\b", statement)
+    if not m:
+        return ""
+    return statement[: m.start()].strip()
+
+
+def _body_chars(
+    code_lines: List[str], body: Tuple[int, int]
+) -> Tuple[str, List[int]]:
+    """Struct body as one string (between the outer braces) + per-char
+    line numbers."""
+    open_ln, close_ln = body
+    chars: List[str] = []
+    lines: List[int] = []
+    for ln in range(open_ln, close_ln + 1):
+        line = code_lines[ln - 1]
+        lo = line.find("{") + 1 if ln == open_ln else 0
+        hi = line.rfind("}") if ln == close_ln else len(line)
+        if hi < lo:
+            hi = lo
+        for c in line[lo:hi]:
+            chars.append(c)
+            lines.append(ln)
+        chars.append("\n")
+        lines.append(ln)
+    return "".join(chars), lines
+
+
+def _struct_fields(
+    code_lines: List[str], raw_lines: List[str], body: Tuple[int, int]
+) -> List[Tuple[str, str, int, bool]]:
+    """[(field, type_token, line, not_reset_annotated)] for depth-1
+    declarations; method bodies and nested types are skipped."""
+    text, linemap = _body_chars(code_lines, body)
+    fields: List[Tuple[str, str, int, bool]] = []
+    seg: List[str] = []
+    seg_lines: List[int] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "{":
+            # Initializer braces ('= { ... }') stay part of the segment;
+            # any other brace opens a method/ctor/nested-type body, which
+            # voids the pending segment.
+            tail = "".join(seg).rsplit(";", 1)[-1]
+            is_init = re.search(r"=\s*[^;{}]*$", tail) is not None
+            depth = 1
+            j = i + 1
+            while j < n and depth:
+                if text[j] == "{":
+                    depth += 1
+                elif text[j] == "}":
+                    depth -= 1
+                j += 1
+            if is_init:
+                seg.append(text[i:j])
+                seg_lines.append(linemap[i])
+            else:
+                seg = []
+                seg_lines = []
+            i = j
+            continue
+        if c == ";":
+            stmt = "".join(seg).strip().replace("\n", " ")
+            first_line = seg_lines[0] if seg_lines else linemap[i]
+            last_line = linemap[i]
+            seg = []
+            seg_lines = []
+            i += 1
+            if not stmt or "(" in stmt.split("=", 1)[0]:
+                continue
+            if any(re.match(rf"\b{k}\b", stmt) for k in _CXX_KEYWORDS):
+                continue
+            decls = _split_top_commas(stmt)
+            first = _field_name(decls[0])
+            if not first:
+                continue
+            ftype = _type_of(stmt, first)
+            annotated = _not_reset_annotated(raw_lines, last_line)
+            fields.append((first, ftype, last_line, annotated))
+            for d in decls[1:]:
+                nm = _field_name(d)
+                if nm:
+                    fields.append((nm, ftype, last_line, annotated))
+            continue
+        if c.strip():
+            if not seg:
+                seg_lines = [linemap[i]]
+            seg.append(c)
+        elif seg:
+            seg.append(" ")
+        i += 1
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# HBC001: exhaustive in-place resets
+# ---------------------------------------------------------------------------
+
+
+def _method_body_text(
+    code_lines: List[str], struct_body: Tuple[int, int], method: str
+) -> Optional[str]:
+    """Flat text of ``void <method>() { ... }`` inside the struct body."""
+    pat = re.compile(rf"\bvoid\s+{method}\s*\(\s*\)")
+    blocks = _Blocks(code_lines)
+    for ln in range(struct_body[0], struct_body[1] + 1):
+        if pat.search(code_lines[ln - 1]):
+            iv = blocks.block_opening_at(ln)
+            if iv:
+                return "\n".join(code_lines[iv[0] - 1 : iv[1]])
+    return None
+
+
+def _mentioned(body: str, dotted: str) -> bool:
+    """Is ``a.b.c`` (or a bare field) mentioned as a reset target?  Any
+    word-boundary mention counts — the failure mode this rule defends
+    against is a field FORGOTTEN entirely, which name-mention catches."""
+    head = dotted.split(".")[0]
+    pat = re.escape(dotted).replace(r"\.", r"\s*\.\s*")
+    return (
+        re.search(rf"(?<![\w.]){pat}(?![\w])", body) is not None
+        if "." in dotted
+        else re.search(rf"(?<![\w.]){re.escape(head)}\b", body) is not None
+    )
+
+
+def _check_reset_coverage(
+    structs: Dict[str, List[Tuple[str, str, int, bool]]],
+    struct_name: str,
+    prefix: str,
+    body: str,
+    path: str,
+    reset_line: int,
+    findings: List[Finding],
+) -> None:
+    for field, ftype, decl_line, annotated in structs[struct_name]:
+        if annotated:
+            continue
+        dotted = f"{prefix}{field}"
+        type_idents = re.findall(r"[A-Za-z_]\w*", ftype)
+        direct = type_idents[-1] if type_idents else ""
+        if direct in structs:
+            # Nested protocol state: a whole-object assignment
+            # ('ba.sbv = Sbv()') resets every nested field at once;
+            # otherwise require each nested field via 'prefix.field.*'.
+            pat = re.escape(dotted).replace(r"\.", r"\s*\.\s*")
+            if re.search(rf"(?<![\w.]){pat}\s*=(?!=)", body):
+                continue
+            _check_reset_coverage(
+                structs, direct, dotted + ".", body, path, reset_line, findings
+            )
+            continue
+        if any(t in structs for t in type_idents):
+            # Container of tracked structs (std::vector<Proposal>,
+            # std::array<Ba, 2>, ...): per-element resets cannot be
+            # verified statically, so a bare mention must not pass.
+            findings.append(
+                Finding(
+                    "HBC001",
+                    path,
+                    decl_line,
+                    f"'{dotted}' of {struct_name} holds"
+                    " reset-tracked structs inside a container: the"
+                    " checker cannot verify per-element resets — reset"
+                    " each element explicitly and annotate the"
+                    " declaration '// lint: not-reset (elements reset"
+                    " via ...)'",
+                )
+            )
+            continue
+        if _mentioned(body, dotted):
+            continue
+        findings.append(
+            Finding(
+                "HBC001",
+                path,
+                decl_line,
+                f"mutable field '{dotted}' of {struct_name} is not restored"
+                f" by the in-place reset (line {reset_line}): a missed field"
+                " is cross-epoch contamination (reset-in-place recycling,"
+                " CLAUDE.md round 5). Reset it, or annotate the declaration"
+                " '// lint: not-reset (<why>)' if it is intentionally"
+                " persistent",
+            )
+        )
+
+
+def rule_field_reset(
+    code_lines: List[str], raw_lines: List[str], path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    structs: Dict[str, List[Tuple[str, str, int, bool]]] = {}
+    bodies: Dict[str, Tuple[int, int]] = {}
+    for name in RESET_STRUCTS:
+        body = _find_struct_body(code_lines, name)
+        if body is None:
+            continue
+        bodies[name] = body
+        structs[name] = _struct_fields(code_lines, raw_lines, body)
+    for owner, method in RESET_METHODS.items():
+        if owner not in bodies:
+            findings.append(
+                Finding("HBC001", path, 1, f"struct {owner} not found")
+            )
+            continue
+        mbody = _method_body_text(code_lines, bodies[owner], method)
+        if mbody is None:
+            findings.append(
+                Finding(
+                    "HBC001",
+                    path,
+                    bodies[owner][0],
+                    f"{owner}::{method} not found (the reset-in-place"
+                    " recycling depends on it)",
+                )
+            )
+            continue
+        reset_line = bodies[owner][0]
+        _check_reset_coverage(
+            structs, owner, "", mbody, path, reset_line, findings
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HBC002: profiling counters are single-writer
+# ---------------------------------------------------------------------------
+
+# Any identifier may hold the engine reference ('e', 'eng', 'engine'):
+# restricting the receiver to a literal 'e' would let one renamed
+# parameter disable the whole rule.
+_REF = r"(?:[A-Za-z_]\w*\s*(?:\.|->)\s*)?"
+_PROF_WRITE_RE = re.compile(
+    rf"(?<![\w.]){_REF}prof_(?:cycles|count)\s*\[[^\]]*\]\s*"
+    r"(\+\+|--|\+=|-=|\|=|&=|\^=|=(?!=))"
+)
+_DECL_RE = re.compile(r"\buint64_t\s+prof_(?:cycles|count)\b")
+_MT_GUARD_RE = re.compile(rf"if\s*\(\s*!\s*{_REF}mt_active\s*\)")
+
+
+def _guard_intervals(
+    code_lines: List[str], blocks: _Blocks, guard_re: re.Pattern
+) -> List[Tuple[int, int]]:
+    """Line ranges covered by each guard.  The guarded region is located
+    from the text AFTER the condition — a brace on an unrelated next
+    line must not be mistaken for the guard's block (that would bless
+    ungoverned writes inside it)."""
+
+    def _block_from(open_line: int) -> Tuple[int, int]:
+        ivs = [iv for iv in blocks.intervals if iv[0] == open_line]
+        # Smallest block opening on that line: over-covering risks
+        # blessing writes the guard does not actually govern.
+        return min(ivs, key=lambda iv: iv[1]) if ivs else (open_line, open_line)
+
+    out = []
+    for ln, line in enumerate(code_lines, 1):
+        m = guard_re.search(line)
+        if not m:
+            continue
+        rest = line[m.end():].strip()
+        if "{" in rest:
+            out.append(_block_from(ln))  # if (...) { ... }
+        elif rest:
+            out.append((ln, ln))  # braceless, statement on the same line
+        else:
+            nxt = code_lines[ln].strip() if ln < len(code_lines) else ""
+            if nxt.startswith("{"):
+                out.append(_block_from(ln + 1))  # Allman brace
+            else:
+                out.append((ln + 1, ln + 1))  # braceless, next line
+    return out
+
+
+def rule_prof_guard(
+    code_lines: List[str], raw_lines: List[str], path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    blocks = _Blocks(code_lines)
+    guards = _guard_intervals(code_lines, blocks, _MT_GUARD_RE)
+    for ln, line in enumerate(code_lines, 1):
+        if _DECL_RE.search(line):
+            continue
+        if not _PROF_WRITE_RE.search(line):
+            continue
+        if any(o <= ln <= c for o, c in guards):
+            continue
+        if _annotated(raw_lines, ln, ST_ONLY_RE):
+            continue
+        findings.append(
+            Finding(
+                "HBC002",
+                path,
+                ln,
+                "profiling-counter write outside an 'if (!e.mt_active)'"
+                " guard: counters are single-writer (engine_run_mt workers"
+                " must never stamp them; CLAUDE.md multicore rules)."
+                " Guard it, or annotate '// lint: st-only (<why>)' for"
+                " code unreachable from worker threads",
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HBC003: shared caches / batch staging only under their mutex
+# ---------------------------------------------------------------------------
+
+_LOCK_RE = re.compile(
+    r"lock_guard\s*<[^>]*>\s*\w+\s*\(\s*(?:[A-Za-z_]\w*\s*(?:\.|->)\s*)?(\w+)\s*\)"
+)
+_SHARED_DECL_RE = re.compile(
+    r"^\s*(?:std::|mutable\s|const\s)\S*\s*<.*>\s*\w+\s*;\s*$"
+)
+
+
+def rule_lock_guard(
+    code_lines: List[str], raw_lines: List[str], path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    blocks = _Blocks(code_lines)
+    # lock_guard coverage: from the lock statement to the close of the
+    # innermost block containing it.
+    locks: List[Tuple[str, int, int]] = []  # (mutex, from_line, to_line)
+    for ln, line in enumerate(code_lines, 1):
+        for m in _LOCK_RE.finditer(line):
+            iv = blocks.innermost_containing(ln)
+            locks.append((m.group(1), ln, iv[1] if iv else len(code_lines)))
+    for name, mutex in MUTEX_FOR.items():
+        for ln, line in enumerate(code_lines, 1):
+            if not re.search(rf"\b{name}\b", line):
+                continue
+            if _SHARED_DECL_RE.match(line):
+                continue  # the declaration inside struct Engine
+            if any(mx == mutex and lo <= ln <= hi for mx, lo, hi in locks):
+                continue
+            if _annotated(raw_lines, ln, ST_ONLY_RE):
+                continue
+            holds = [
+                hm.group(1)
+                for i in range(max(ln - 2, 1), ln + 1)
+                for hm in HOLDS_RE.finditer(raw_lines[i - 1])
+            ]
+            if mutex in holds:
+                continue
+            findings.append(
+                Finding(
+                    "HBC003",
+                    path,
+                    ln,
+                    f"'{name}' is touched without holding {mutex}:"
+                    " worker-reachable shared state (CLAUDE.md multicore"
+                    " rules). Take a std::lock_guard, or annotate"
+                    f" '// lint: holds-{mutex} (<why>)' when the caller"
+                    " provably holds it (or '// lint: st-only')",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# HBC004: profiling-slot registry
+# ---------------------------------------------------------------------------
+
+_SLOT_RE = re.compile(r"\bprof_(?:cycles|count)\s*\[\s*(\d+)\s*\]")
+
+
+def rule_slot_registry(
+    code_lines: List[str], raw_lines: List[str], path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Dict[int, int] = {}
+    is_engine = path.endswith("engine.cpp")
+    for ln, line in enumerate(code_lines, 1):
+        if _DECL_RE.search(line):
+            continue  # the [16] in the array declaration
+        for m in _SLOT_RE.finditer(line):
+            slot = int(m.group(1))
+            seen.setdefault(slot, ln)
+            if slot in CLAIMED_SLOTS:
+                continue
+            if slot in FREE_SLOTS:
+                findings.append(
+                    Finding(
+                        "HBC004",
+                        path,
+                        ln,
+                        f"literal profiling slot {slot} is FREE in"
+                        " tools/lint/slot_registry.py: claim it there (in"
+                        " this change) before stamping, so concurrent"
+                        " instrumentation never corrupts a profile",
+                    )
+                )
+            elif slot in TYPED_DELIVERY_SLOTS:
+                findings.append(
+                    Finding(
+                        "HBC004",
+                        path,
+                        ln,
+                        f"literal profiling slot {slot} is in the typed"
+                        " delivery range (prof_cycles[ty], MsgType 0..10):"
+                        " a literal stamp there corrupts the per-type"
+                        " delivery profile",
+                    )
+                )
+            else:
+                findings.append(
+                    Finding(
+                        "HBC004",
+                        path,
+                        ln,
+                        f"literal profiling slot {slot} is out of range"
+                        " (the engine has 16 slots)",
+                    )
+                )
+    # Stale-claim detection is only meaningful against the registry's
+    # single source of truth (the real engine.cpp) — fixtures and
+    # partial sources legitimately omit claimed slots.
+    for slot, owner in CLAIMED_SLOTS.items() if is_engine else ():
+        if slot not in seen:
+            findings.append(
+                Finding(
+                    "HBC004",
+                    path,
+                    1,
+                    f"slot {slot} is claimed in tools/lint/slot_registry.py"
+                    f" ('{owner}') but never used in {path}: release the"
+                    " stale claim so the slot returns to the free pool",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+_RULES = (rule_field_reset, rule_prof_guard, rule_lock_guard, rule_slot_registry)
+
+
+def lint_source(src: str, path: str = "native/engine.cpp") -> List[Finding]:
+    """Lint C++ source text (tests feed patched strings through this)."""
+    code_lines, raw_lines = _strip(src)
+    findings: List[Finding] = []
+    for rule in _RULES:
+        findings.extend(rule(code_lines, raw_lines, path))
+    return findings

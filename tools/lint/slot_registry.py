@@ -1,0 +1,27 @@
+"""Profiling-slot registry for native/engine.cpp (enforced by HBC004).
+
+The engine keeps 16 rdtsc counter slots (``Engine::prof_cycles`` /
+``prof_count``).  Slots 0..10 are indexed dynamically by delivered
+message type (``enum MsgType``); the rest are claimed by literal index
+for specific instrumentation.  Claiming a slot == editing this file in
+the same change that adds the stamp; the linter fails on any literal
+slot index in engine.cpp that is FREE here (use without claiming would
+silently corrupt an existing profile) and on claimed slots that no
+longer appear (stale claims hide genuinely free slots).
+
+History: round 4 claimed 11/13/14; round-5 cleanup returned 12/15 to
+the free pool (CLAUDE.md perf-state notes).
+"""
+
+# Dynamic range: prof_cycles[ty] / prof_count[ty], ty = MsgType 0..10.
+TYPED_DELIVERY_SLOTS = frozenset(range(0, 11))
+
+# Literal-index claims: slot -> owner/purpose.
+CLAIMED_SLOTS = {
+    11: "continuation max cycles (engine_flush_pool tail split, round 4)",
+    13: "continuation tail >1M cycles (engine_flush_pool, round 4)",
+    14: "pool-flush continuation total (engine_flush_pool, round 4)",
+}
+
+# Free for temporary instrumentation: claim here before stamping.
+FREE_SLOTS = frozenset({12, 15})
