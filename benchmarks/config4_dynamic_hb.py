@@ -134,20 +134,35 @@ def main_native() -> None:
     epochs_after = max(len(nat.nodes[i].outputs) for i in nat.correct_ids)
     assert not nat.nodes[victim].qhb.dhb.netinfo.is_validator()
 
-    print(
-        json.dumps(
-            {
-                "config": "dynamic_hb_64node_churn",
-                "engine": "native",
-                "nodes": n,
-                "keygen_setup_s": round(setup_s, 2),
-                "plain_epoch_wall_s": round(epoch_s, 2),
-                "era_change_wall_s": round(churn_s, 2),
-                "epochs_to_complete_change": epochs_after - epochs_before,
-                "delivered_msgs": nat.delivered,
-            }
-        )
-    )
+    record = {
+        "config": "dynamic_hb_64node_churn",
+        "engine": "native",
+        "nodes": n,
+        "keygen_setup_s": round(setup_s, 2),
+        "plain_epoch_wall_s": round(epoch_s, 2),
+        "era_change_wall_s": round(churn_s, 2),
+        "epochs_to_complete_change": epochs_after - epochs_before,
+        "delivered_msgs": nat.delivered,
+    }
+    if os.environ.get("BENCH_PROF"):
+        # Continuation-tail split in Gcyc (hbe_prof_cycles — the A/B
+        # currency per the clock-drift rule in CLAUDE.md): 14 = all
+        # pool-flush continuations, 13 = the > 1M-cycle tail, 11 = max
+        # single continuation, 12/15 = Python batch_cb / contrib_cb
+        # wall (the round-6 batch-digest split).
+        lib, h = nat.lib, nat.handle
+        prof = {}
+        for slot, name in (
+            (14, "cont_total"), (13, "cont_tail_gt1m"), (11, "cont_max"),
+            (12, "batch_cb"), (15, "contrib_cb"),
+        ):
+            prof[name + "_gcyc"] = round(
+                int(lib.hbe_prof_cycles(h, slot)) / 1e9, 3
+            )
+            prof[name + "_n"] = int(lib.hbe_prof_count(h, slot))
+        record["prof"] = prof
+        record["dkg_batch"] = os.environ.get("HBBFT_TPU_DKG_BATCH", "1")
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ Scheme (conventions as in the reference):
 
 from __future__ import annotations
 
+import os
 import warnings
 from dataclasses import dataclass
 from typing import Any, Dict, Mapping, Optional
@@ -269,6 +270,18 @@ class PublicKeySet:
                 f"need {self.threshold + 1} shares, got {len(shares)}"
             )
         idxs = sorted(shares)[: self.threshold + 1]
+        # Scalar-suite vectorized combine: one C Lagrange call, same
+        # mod-r sum as the loop below (fast path only for well-formed
+        # scalar shares; anything else keeps the pure path).
+        fast = _scalar_kem(self.suite)
+        if fast is not None and _native_combine_enabled():
+            vals = fast.share_values(idxs, shares, "g2")
+            if vals is not None:
+                acc = fast.combine_at_zero(idxs, vals)
+                if acc is not None:
+                    return Signature(
+                        fast._g_type(acc, fast._mod), self.suite
+                    )
         lam = lagrange_coefficients(idxs, self.suite.scalar_modulus)
         acc = None
         for i in idxs:
@@ -285,6 +298,17 @@ class PublicKeySet:
                 f"need {self.threshold + 1} shares, got {len(shares)}"
             )
         idxs = sorted(shares)[: self.threshold + 1]
+        # Scalar-suite vectorized combine + kdf + xor in one C call —
+        # byte-identical to the pure path below (the combine itself is
+        # the same mod-r Lagrange sum; the kdf framing is the shared
+        # scalar-KEM code the equivalence suites pin).
+        fast = _scalar_kem(self.suite)
+        if fast is not None and _native_combine_enabled() and isinstance(ct.v, bytes):
+            vals = fast.share_values(idxs, shares, "g1")
+            if vals is not None:
+                out = fast.combine_unmask(idxs, vals, ct.v)
+                if out is not None:
+                    return out
         lam = lagrange_coefficients(idxs, self.suite.scalar_modulus)
         acc = None
         for i in idxs:
@@ -347,6 +371,7 @@ class _ScalarKem:
         self._suite = suite
         self._g_type = type(suite.g1_generator())
         self._mod = suite.scalar_modulus
+        self._r_be = suite.scalar_modulus.to_bytes(32, "big")
 
     def ct_ok(self, ct: Any) -> bool:
         """Fast path only for structurally sound scalar ciphertexts; the
@@ -407,6 +432,96 @@ class _ScalarKem:
         )
         object.__setattr__(ct, "_verify_ok", bool(ok))
         return bytes(out) if ok else None
+
+    # -- vectorized Lagrange combines (round 6) ------------------------
+    #
+    # One C call for the whole Lagrange sum (hbe_scalar_interp_sum /
+    # hbe_scalar_combine_unmask mirror crypto/poly.py interpolate and
+    # the kem kdf framing exactly) — the per-batch threshold combines
+    # are part of the era-change Python tail.  Callers validate the
+    # share shapes; a None return means "fall back to the pure path".
+
+    def _xs_ys(self, idxs: Any, values: Any) -> Optional[tuple]:
+        import ctypes
+
+        # Explicit int32 bound: ctypes c_int32 arrays silently TRUNCATE
+        # oversized Python ints (no OverflowError), which would hand the
+        # C Lagrange a wrong-but-positive evaluation point and return a
+        # silently wrong combine instead of falling back.
+        if any(
+            isinstance(i, bool) or not isinstance(i, int)
+            or i < 0 or i + 1 >= (1 << 31)
+            for i in idxs
+        ):
+            return None
+        xs = (ctypes.c_int32 * len(idxs))(*[i + 1 for i in idxs])
+        ys = b"".join(v.to_bytes(32, "big") for v in values)
+        return xs, ys
+
+    def combine_at_zero(self, idxs: Any, values: Any) -> Optional[int]:
+        """sum_i lam_i * values[i] interpolated at 0 over x_i = i + 1
+        (the scalar combine_signatures kernel)."""
+        import ctypes
+
+        prep = self._xs_ys(idxs, values)
+        if prep is None:
+            return None
+        xs, ys = prep
+        counts = (ctypes.c_int32 * 1)(len(idxs))
+        out = (ctypes.c_uint8 * 32)()
+        ok = int(
+            self._lib.hbe_scalar_interp_sum(xs, ys, counts, 1, self._r_be, out)
+        )
+        return int.from_bytes(bytes(out), "big") if ok else None
+
+    def combine_unmask(self, idxs: Any, values: Any, v: bytes) -> Optional[bytes]:
+        """Lagrange-combine decryption shares and unmask ``v`` in one C
+        call (combine + kdf + xor; the combine_decryption_shares
+        kernel)."""
+        import ctypes
+
+        prep = self._xs_ys(idxs, values)
+        if prep is None:
+            return None
+        xs, ys = prep
+        out = (ctypes.c_uint8 * len(v))()
+        ok = int(
+            self._lib.hbe_scalar_combine_unmask(
+                xs, len(idxs), ys, self._r_be, v, len(v), out
+            )
+        )
+        return bytes(out) if ok else None
+
+    def share_values(self, idxs: Any, shares: Any, attr: str) -> Optional[list]:
+        """The int group-element values of ``shares[i].<attr>`` for the
+        chosen indices — None unless every one is a well-formed scalar
+        element of this suite (the fast-path admission check)."""
+        vals = []
+        for i in idxs:
+            if isinstance(i, bool) or not isinstance(i, int) or i < 0:
+                return None
+            g = getattr(shares[i], attr, None)
+            if (
+                type(g) is not self._g_type
+                or not isinstance(getattr(g, "value", None), int)
+                or getattr(g, "modulus", None) != self._mod
+                or not 0 <= g.value < self._mod
+            ):
+                return None
+            vals.append(g.value)
+        return vals
+
+
+def dkg_batch_enabled() -> bool:
+    """THE kill switch for every round-6 batch-plane fast path — the
+    sync_key_gen predigest / vectorized generate AND the scalar
+    combines here — so one env var (HBBFT_TPU_DKG_BATCH=0) A/Bs the
+    whole plane against the per-item round-5 behavior.  Single
+    definition; sync_key_gen imports it."""
+    return os.environ.get("HBBFT_TPU_DKG_BATCH", "1") != "0"
+
+
+_native_combine_enabled = dkg_batch_enabled
 
 
 _KEM_CACHE: Dict[Any, Optional[_ScalarKem]] = {}

@@ -454,10 +454,13 @@ def scalar_mul_rlc_g1(base: Point, bits_lsb: jnp.ndarray) -> Tuple[Point, Point]
     # The [x^2]P check chain reads chain points at x^2's set bits; a
     # narrower scan would silently truncate the check scalar and reject
     # every genuine point (fail-closed but undiagnosable) — mirror the
-    # G2 scan's width assertion instead.
-    assert nbits >= XSQ.bit_length(), (
-        f"RLC bit width {nbits} < x^2 width {XSQ.bit_length()}"
-    )
+    # G2 scan's width guard.  Explicit raise, not assert: `python -O`
+    # strips asserts, and this failure mode is exactly the one that
+    # must stay loud (ADVICE round 5).
+    if nbits < XSQ.bit_length():
+        raise ValueError(
+            f"RLC bit width {nbits} < x^2 width {XSQ.bit_length()}"
+        )
     batch = bits_lsb.shape[:-1]
     acc = identity(ops, batch)
     started = jnp.zeros(batch, dtype=jnp.int32)

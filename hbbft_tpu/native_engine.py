@@ -58,7 +58,12 @@ from hbbft_tpu.crypto.keys import (
 )
 from hbbft_tpu.crypto.pool import VerifySink
 from hbbft_tpu.crypto.suite import ScalarSuite, Suite
-from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch, DynamicHoneyBadger
+from hbbft_tpu.protocols.dynamic_honey_badger import (
+    DhbBatch,
+    DynamicHoneyBadger,
+    InternalContrib,
+    SignedKeyGenMsg,
+)
 from hbbft_tpu.protocols.honey_badger import Batch, EncryptionSchedule
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.queueing_honey_badger import QueueingHoneyBadger
@@ -291,6 +296,27 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_dkg_row_check.argtypes = [
         ctypes.c_int64, ctypes.c_int32, cp, ctypes.c_int32,
     ]
+    # batch DKG digest (round 6): whole-batch ack/part checks + the
+    # vectorized Lagrange/combine entry points
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.hbe_dkg_ack_check_batch.restype = ctypes.c_int32
+    lib.hbe_dkg_ack_check_batch.argtypes = [
+        i64p, i32p, ctypes.c_int32, ctypes.c_int32, cp, cp, cp, cp,
+        i32p, u8p,
+    ]
+    lib.hbe_dkg_part_check_batch.restype = ctypes.c_int32
+    lib.hbe_dkg_part_check_batch.argtypes = [
+        i64p, ctypes.c_int32, ctypes.c_int32, cp, cp, cp, ctypes.c_int32,
+        cp, i32p, u8p,
+    ]
+    lib.hbe_scalar_interp_sum.restype = ctypes.c_int32
+    lib.hbe_scalar_interp_sum.argtypes = [
+        i32p, cp, i32p, ctypes.c_int32, cp, u8p,
+    ]
+    lib.hbe_scalar_combine_unmask.restype = ctypes.c_int32
+    lib.hbe_scalar_combine_unmask.argtypes = [
+        i32p, ctypes.c_int32, cp, cp, cp, ctypes.c_uint64, u8p,
+    ]
     lib.hbe_dkg_row_evals.restype = None
     lib.hbe_dkg_row_evals.argtypes = [
         cp, ctypes.c_int32, ctypes.c_int32, u8p,
@@ -503,7 +529,21 @@ class _NativeNode:
 class NativeQhbNet:
     """Engine-backed QueueingHoneyBadger network (NetBuilder-compatible
     key generation and rng seeding, so runs are comparable to the
-    Python VirtualNet at the same seed)."""
+    Python VirtualNet at the same seed).
+
+    ``threads=N`` (N > 1) runs the engine's generation-parallel
+    multicore scheduler (``engine_run_mt``).  Its byte-identity with
+    ``threads=1`` rests on an obligation this class's own callbacks
+    honor and any SUBCLASS/EXTENSION must too: **Python batch/contrib
+    callbacks may only touch per-node state** (per-node rngs, per-node
+    protocol instances) or pure-function caches keyed by all of their
+    inputs.  Cross-node mutable state in a callback — e.g. one shared
+    rng, or a node-dependent memo on a shared decoded object — would
+    make outputs depend on the worker interleaving and silently diverge
+    from ``threads=1`` (the C++-side argument lives at engine_run_mt in
+    native/engine.cpp; the Python-side contract is stated here because
+    callbacks are where users extend the net).  Scalar internal-crypto
+    mode only; external crypto and adversaries are rejected."""
 
     def __init__(
         self,
@@ -735,9 +775,46 @@ class NativeQhbNet:
         batch = Batch(epoch, tuple(contribs))
         dhb: NativeDhb = nd.qhb.dhb  # type: ignore[assignment]
         dhb._rng = nd.rng
-        step = dhb._process_batch(batch)
+        # Batch-digest fast path: hand the whole batch's DKG private
+        # checks to ONE native call before the per-message processing
+        # walks it (the round-5 continuation-tail lever).  Per-item
+        # misses fall back inside handle_part/handle_ack; a nested
+        # batch event (a proposal fired from inside _process_batch)
+        # clears the outer digests early, which only costs speed.
+        skg = self._predigest_dkg(dhb, batch)
+        try:
+            step = dhb._process_batch(batch)
+        finally:
+            if skg is not None:
+                skg.clear_predigest()
         step = nd.qhb._absorb(step, nd.rng)
         nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
+
+    @staticmethod
+    def _predigest_dkg(dhb: "NativeDhb", batch: Batch) -> Any:
+        """Collect the batch's in-era key-gen messages and batch their
+        private checks into the node's SyncKeyGen (no-op without a DKG
+        in flight).  Returns the SyncKeyGen whose digests must be
+        cleared after the batch, or None."""
+        state = dhb._key_gen
+        if state is None or state.key_gen is None:
+            return None
+        skg = state.key_gen
+        msgs = []
+        for _, contrib in batch.contributions:
+            if not isinstance(contrib, InternalContrib):
+                continue
+            for kg in contrib.key_gen_messages:
+                if isinstance(kg, SignedKeyGenMsg) and kg.era == dhb._era:
+                    msgs.append((kg.sender, kg.payload))
+        if msgs:
+            try:
+                skg.predigest_batch(msgs)
+            except Exception:
+                # Digesting is an optimization only: any failure leaves
+                # the per-item paths to re-derive every verdict.
+                skg.clear_predigest()
+        return skg
 
     # -- external-crypto callbacks -------------------------------------
     #

@@ -8,6 +8,13 @@ loop), and after ``f + 1`` valid shares combines them into the plaintext.
 
 Shares arriving before the ciphertext are buffered raw and verified once
 the ciphertext is known — asynchrony means peers may be ahead of us.
+
+Combining is delegated to ``PublicKeySet.combine_decryption_shares``,
+which on the scalar suite routes through the engine's vectorized
+Lagrange+unmask entry point (``hbe_scalar_combine_unmask``, round 6):
+the per-epoch combine of a DKG-sized ciphertext — Lagrange sum plus a
+kdf stream over hundreds of KB — was part of the measured era-change
+batch tail, and is byte-identical through either path.
 """
 
 from __future__ import annotations
@@ -142,6 +149,8 @@ class ThresholdDecrypt(ConsensusProtocol):
         by_index = {
             self._netinfo.index(nid): sh for nid, sh in self._verified.items()
         }
+        # One call: Lagrange combine + unmask (native vectorized on the
+        # scalar suite — module docstring).
         self._plaintext = pks.combine_decryption_shares(by_index, self._ciphertext)
         self._terminated = True
         return step.with_output(self._plaintext)

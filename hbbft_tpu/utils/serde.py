@@ -66,6 +66,11 @@ _T_GROUP = 0x11
 _STRUCTS: Dict[str, Tuple[Type, Callable[[Any], tuple], Callable[[tuple], Any]]] = {}
 _STRUCT_BY_CLS: Dict[Type, str] = {}
 
+# name -> token-level fast builder for the native-scan path (see
+# register_token_struct).  Purely an accelerator: absence or a None
+# return changes nothing.
+_TOKEN_STRUCTS: Dict[str, Callable] = {}
+
 # suite name -> suite instance (for group-element decoding)
 _SUITES: Dict[str, Any] = {}
 
@@ -87,6 +92,23 @@ def register_struct(
 
 def register_suite(suite: Any) -> None:
     _SUITES[suite.name] = suite
+
+
+def register_token_struct(name: str, fast: Callable) -> None:
+    """Register a token-level fast builder for struct ``name`` on the
+    native-scan decode path (hot committed types; wire.py registers one
+    for the scalar ``"ct"`` — DKG-epoch payloads carry ~N^2 of them).
+
+    ``fast(tokens, ti, data, suite_name)`` is called at the struct's
+    FIELDS node and must either return ``(obj, next_ti)`` — with ``obj``
+    EXACTLY what the generic ``_build`` + registered unpack would
+    construct and ``next_ti`` just past the fields subtree — or return
+    None for anything even slightly unusual (other suite, pin mismatch,
+    malformed shape), deferring to the generic path so the canonical
+    validation and DecodeError behavior apply.  The scan/pure
+    fuzz-equivalence tests (tests/test_serde.py) pin both properties.
+    """
+    _TOKEN_STRUCTS[name] = fast
 
 
 def get_suite(name: str) -> Any:
@@ -477,6 +499,11 @@ def _build(t: Any, ti: int, data: bytes, suite_name: Any, depth: int):
         entry = _STRUCTS.get(name)
         if entry is None:
             raise DecodeError(f"unknown struct {name!r}")
+        fast = _TOKEN_STRUCTS.get(name)
+        if fast is not None:
+            res = fast(t, ti, data, suite_name)
+            if res is not None:
+                return res
         fields, ti = _build(t, ti, data, suite_name, depth + 1)
         if not isinstance(fields, tuple):
             raise DecodeError("struct fields must be a tuple")

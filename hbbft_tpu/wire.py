@@ -42,7 +42,7 @@ from typing import Any
 
 from hbbft_tpu.crypto.keys import Ciphertext, PublicKey, Signature
 from hbbft_tpu.crypto.poly import BivarCommitment, Commitment
-from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.crypto.suite import ScalarG, ScalarSuite
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     Change,
     InternalContrib,
@@ -52,11 +52,13 @@ from hbbft_tpu.protocols.dynamic_honey_badger import (
 )
 from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
 from hbbft_tpu.protocols.sync_key_gen import Ack, Part
+from hbbft_tpu.utils import serde
 from hbbft_tpu.utils.serde import (
     DecodeError,
     get_suite,
     register_struct,
     register_suite,
+    register_token_struct,
 )
 
 # -- suites -----------------------------------------------------------------
@@ -124,6 +126,76 @@ def _g2(suite: Any, v: Any, what: str) -> Any:
 
 def _pack_ciphertext(ct: Ciphertext) -> tuple:
     return (ct.suite.name, ct.u, ct.v, ct.w)
+
+
+# Token-level fast builder for the scalar "ct" struct on the native-scan
+# decode path (serde.register_token_struct).  A DKG-epoch contribution
+# carries ~N^2 of these, and the generic build (recursive field
+# construction + validating unpack) was the measured bulk of the
+# committed-payload decode at era changes (round-6 contrib_cb split).
+# Accepts ONLY the exact canonical shape the encoder emits — tuple(4),
+# scalar suite name, 32-byte in-range group values with group id 1/2,
+# bytes v — and constructs precisely what _unpack_ciphertext would;
+# ANYTHING else returns None so the generic path applies the canonical
+# validation and error behavior (the scan/pure fuzz-equivalence test
+# sweeps corruptions over a ct encoding to pin this).
+_SCALAR_NAME_RAW = b"scalar-insecure"
+_T_GROUP_CT = 0x11
+
+
+def _fast_build_ct(t: Any, ti: int, data: bytes, suite_name: Any):
+    base = 3 * ti
+    if t[base] != 0x06 or t[base + 1] != 4:  # fields tuple(4)
+        return None
+    ti += 1
+    base = 3 * ti
+    if t[base] != 0x05:  # field 0: suite-name str
+        return None
+    off = t[base + 1]
+    if data[off : off + t[base + 2]] != _SCALAR_NAME_RAW:
+        return None  # other suites / junk: generic path decides
+    if suite_name is not None and suite_name != "scalar-insecure":
+        return None  # pin mismatch: generic path raises
+    suite = serde._SUITES.get("scalar-insecure")
+    if suite is None:
+        return None
+    mod = suite.scalar_modulus
+    ti += 1
+
+    def group(ti: int):
+        # GROUP token + extra (group_id, payload) triple; mirrors
+        # ScalarSuite.g1_from_bytes (== g2_from_bytes): 32 bytes, < r.
+        base = 3 * ti
+        if t[base] != _T_GROUP_CT:
+            return None
+        off = t[base + 1]
+        if data[off : off + t[base + 2]] != _SCALAR_NAME_RAW:
+            return None
+        base += 3
+        grp = t[base]
+        if (grp != 1 and grp != 2) or t[base + 2] != 32:
+            return None
+        poff = base + 1
+        v = int.from_bytes(data[t[poff] : t[poff] + 32], "big")
+        if v >= mod:
+            return None
+        return ScalarG(v, mod), ti + 2
+
+    res = group(ti)
+    if res is None:
+        return None
+    u, ti = res
+    base = 3 * ti
+    if t[base] != 0x04:  # field 2: v bytes
+        return None
+    off = t[base + 1]
+    v = data[off : off + t[base + 2]]
+    ti += 1
+    res = group(ti)
+    if res is None:
+        return None
+    w, ti = res
+    return Ciphertext(u, v, w, suite), ti
 
 
 def _unpack_ciphertext(f: tuple) -> Ciphertext:
@@ -355,6 +427,7 @@ def _unpack_ack(f: tuple) -> Ack:
 # -- registration -----------------------------------------------------------
 
 register_struct("ct", Ciphertext, _pack_ciphertext, _unpack_ciphertext)
+register_token_struct("ct", _fast_build_ct)
 register_struct("sig", Signature, _pack_signature, _unpack_signature)
 register_struct("pk", PublicKey, _pack_public_key, _unpack_public_key)
 register_struct("comm", Commitment, _pack_commitment, _unpack_commitment)

@@ -1026,17 +1026,45 @@ struct Engine {
   // Per-message-type delivery profiling (rdtsc cycles + counts).
   uint64_t prof_cycles[16] = {};
   uint64_t prof_count[16] = {};
+  // batch_cb nesting depth (a batch callback may propose, re-entering
+  // commit_events): the slot-12 stamp counts only outermost callbacks,
+  // whose wall already includes any nested ones.  Written only on the
+  // sequential driver path (same single-writer rule as the counters).
+  int batch_cb_depth = 0;
   // KDF-mask cache keyed by the combined share (s*U, 32B BE): any t+1
   // valid decryption shares of a ciphertext interpolate the SAME point,
   // so the expensive kdf_stream over multi-KB ciphertexts (DKG-epoch
   // payloads) runs once per ciphertext instead of once per node.
   std::map<Root, Bytes> mask_by_acc;
   std::deque<Root> mask_order;
+  // Ciphertext-hash cache keyed by the SHARED decoded payload object
+  // (round 6): hash_to_g2 over the ct hash input re-reads the whole
+  // ciphertext body — ~12M cycles for a DKG-epoch payload — and every
+  // node was recomputing it for the same committed value (the measured
+  // bulk of the non-Python continuation tail at era changes).  All
+  // nodes hold the SAME BytesP via decoded_roots, so key by pointer
+  // identity and PIN the payload (shared_ptr) so an address can never
+  // be reused while its entry lives.  This mirrors the Python net's
+  // Ciphertext.hash_input/_verify_ok memos on shared decoded objects —
+  // an optimization the engine was missing, never a semantics change
+  // (the hash is a pure function of the pinned bytes).
+  std::map<const Bytes*, std::pair<BytesP, U256>> ct_hash_by_payload;
+  std::deque<const Bytes*> ct_hash_order;
+  // HBBFT_TPU_CT_HASH_CACHE=0 disables the cache (read at hbe_create):
+  // the HEAD-equivalent leg of back-to-back A/B measurements, and an
+  // escape hatch for the payload pinning if memory ever matters more
+  // than the recompute.
+  bool ct_hash_cache = true;
 };
 
 const size_t MASK_CACHE_MAX = 4096;
 
 const size_t DECODED_ROOTS_MAX = 8192;
+
+// ct-hash entries pin their payloads; DKG-epoch payloads are hundreds
+// of KB, so the cap is sized for N concurrent decrypts plus headroom
+// rather than the roomy counts of the byte-small caches above.
+const size_t CT_HASH_CACHE_MAX = 1024;
 
 inline void pool_push(Engine& e, Node& node, Pending&& p) {
   node.pool.push_back(std::move(p));
@@ -2248,12 +2276,37 @@ struct Ctx {
     return td;
   }
 
+  // hash_to_g2 of the ct hash input, once per distinct committed
+  // payload network-wide (Engine::ct_hash_by_payload notes).  The
+  // heavy sha3 runs OUTSIDE the lock; a concurrent double-compute is
+  // harmless (pure function, first emplace wins).
+  U256 ct_hash_cached(const BytesP& payload, const ScalarCiphertext& ct) {
+    if (!e.ct_hash_cache) return ct_hash_scalar(ct);
+    {
+      std::lock_guard<std::mutex> lk(e.cache_mu);
+      auto it = e.ct_hash_by_payload.find(payload.get());
+      if (it != e.ct_hash_by_payload.end()) return it->second.second;
+    }
+    U256 h = ct_hash_scalar(ct);
+    std::lock_guard<std::mutex> lk(e.cache_mu);
+    auto ins = e.ct_hash_by_payload.emplace(
+        payload.get(), std::make_pair(payload, h));
+    if (ins.second) {
+      e.ct_hash_order.push_back(payload.get());
+      if (e.ct_hash_order.size() > CT_HASH_CACHE_MAX) {
+        e.ct_hash_by_payload.erase(e.ct_hash_order.front());
+        e.ct_hash_order.pop_front();
+      }
+    }
+    return h;
+  }
+
   void td_handle_input(EpochState& st, int proposer, std::shared_ptr<Td> td,
-                       const ScalarCiphertext& ct) {
+                       const ScalarCiphertext& ct, const BytesP& payload) {
     if (td->has_ct || td->terminated) return;
     td->has_ct = true;
     td->ct = ct;
-    td->ct_h = ct_hash_scalar(ct);
+    td->ct_h = ct_hash_cached(payload, ct);
     Pending p;
     p.cont = CONT_TD_CT;
     p.era = node.era;
@@ -2537,9 +2590,20 @@ struct Ctx {
   void hb_accept_plaintext(EpochState& st, int proposer, const BytesP& data) {
     if (st.decrypted.has(proposer) || st.faulty_proposers.has(proposer)) return;
     int ok = 1;
-    if (e.contrib_cb)
+    if (e.contrib_cb) {
+      // Slot 15: cycles inside the Python contrib callback (the
+      // InternalContrib serde-decode half of the era-change tail) —
+      // with slot 12 this splits the slot-13/14 continuation totals
+      // into decode vs batch-processing before/after the batch-digest
+      // fast path.
+      uint64_t t0 = prof_tick();
       ok = e.contrib_cb(node.id, node.era, st.epoch, proposer,
                         (const uint8_t*)data->data(), data->size());
+      if (!e.mt_active) {
+        e.prof_cycles[15] += prof_tick() - t0;
+        e.prof_count[15]++;
+      }
+    }
     if (!ok) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CONTRIB);
@@ -2621,7 +2685,7 @@ struct Ctx {
       return;
     }
     auto td = hb_get_decrypt(st, proposer);
-    td_handle_input(st, proposer, td, ct);
+    td_handle_input(st, proposer, td, ct, payload);
     // _on_decrypt_step boundary after handle_input (no outputs possible,
     // ciphertext_invalid not yet known — verification is deferred).
   }
@@ -2775,7 +2839,23 @@ struct Ctx {
       // re-enters here on the same thread.
       std::lock_guard<std::recursive_mutex> lk(e.cb_mu);
       e.cur_batch = bd.contributions;
-      if (e.batch_cb) e.batch_cb(node.id, bd.era, bd.epoch);
+      if (e.batch_cb) {
+        // Slot 12: cycles spent inside the Python batch callback — the
+        // per-batch DKG/decrypt tail the round-5 envelope profile
+        // pinned (92% of continuation cycles; CLAUDE.md).  Outermost
+        // invocations only (batch_cb_depth), so nested proposals'
+        // batches are not double-counted.
+        uint64_t t0 = prof_tick();
+        e.batch_cb_depth++;
+        e.batch_cb(node.id, bd.era, bd.epoch);
+        e.batch_cb_depth--;
+        if (!e.mt_active) {
+          if (e.batch_cb_depth == 0) {
+            e.prof_cycles[12] += prof_tick() - t0;
+            e.prof_count[12]++;
+          }
+        }
+      }
     }
   }
 };
@@ -3114,6 +3194,41 @@ inline DkgCommit* dkg_get(DkgRegistry& reg, int64_t cid) {
   return &reg.entries[idx];
 }
 
+// By-value snapshot of one registered commitment's data for a given
+// evaluation point: everything the ack/row checks need OUTSIDE the
+// registry mutex (the by-value lagrange_cached pattern — the KEM
+// decrypt + Horner evaluations must not serialize all concurrent DKG
+// checks process-wide; ctypes drops the GIL, so multi-threaded Python
+// callers otherwise contend on the one global lock).
+struct DkgRowCopy {
+  bool ok = false;
+  U256 g = U256_ZERO;
+  int n1 = 0;
+  std::vector<U256> row;  // committed row coeffs at the requested x
+};
+
+// Caller holds the registry mutex.
+inline DkgRowCopy dkg_copy_row(DkgRegistry& reg, int64_t cid, int x) {
+  DkgRowCopy out;
+  DkgCommit* c = dkg_get(reg, cid);
+  if (!c) return out;
+  out.ok = true;
+  out.g = c->g;
+  out.n1 = c->n1;
+  out.row = dkg_row(*c, x);  // copy out by value
+  return out;
+}
+
+// row(x) evaluated at y by Horner (the commitment consistency check's
+// expected value); runs lock-free over a DkgRowCopy.
+inline U256 dkg_row_eval(const DkgRowCopy& rc, int y) {
+  U256 ys = {{(uint64_t)y, 0, 0, 0}};
+  U256 acc = U256_ZERO;
+  for (int j = rc.n1 - 1; j >= 0; --j)
+    acc = addmod(mulmod(acc, ys), rc.row[j]);
+  return acc;
+}
+
 }  // namespace
 
 // ===========================================================================
@@ -3253,21 +3368,93 @@ int32_t hbe_dkg_ack_check(int64_t cid, int32_t sender_pos, int32_t our_pos,
                           const uint8_t* u_be, const uint8_t* v32,
                           const uint8_t* w_be, const uint8_t* sk_be,
                           uint8_t* out_val32) {
-  DkgRegistry& reg = dkg_registry();
-  std::lock_guard<std::mutex> lk(reg.mu);
-  DkgCommit* c = dkg_get(reg, cid);
-  if (!c) return -1;
+  // Row snapshot under the lock; decrypt + Horner OUTSIDE it (the
+  // by-value lagrange_cached pattern — see DkgRowCopy).
+  DkgRowCopy rc;
+  {
+    DkgRegistry& reg = dkg_registry();
+    std::lock_guard<std::mutex> lk(reg.mu);
+    rc = dkg_copy_row(reg, cid, sender_pos);
+  }
+  if (!rc.ok) return -1;
   uint8_t plain[32];
   if (!hbe_kem_decrypt(u_be, v32, 32, w_be, sk_be, plain)) return 0;
   U256 val = u256_from_be(plain, 32);
   if (!(u256_cmp(val, R_MOD) < 0)) return 2;
-  const std::vector<U256>& row = dkg_row(*c, sender_pos);
-  U256 y = {{(uint64_t)our_pos, 0, 0, 0}};
-  U256 expected = U256_ZERO;
-  for (int j = c->n1 - 1; j >= 0; --j)
-    expected = addmod(mulmod(expected, y), row[j]);
-  if (!(mulmod(c->g, val) == expected)) return 2;
+  U256 expected = dkg_row_eval(rc, our_pos);
+  if (!(mulmod(rc.g, val) == expected)) return 2;
   std::memcpy(out_val32, plain, 32);
+  return 1;
+}
+
+// Batched hbe_dkg_ack_check: ONE call for a whole committed batch's ack
+// slots (the era-change continuation tail is per-batch Python work —
+// this is the native half of the batch-digest fast path).  cids and
+// sender positions vary per item (a batch's acks reference different
+// dealers' commitments); our_pos and the secret key are fixed (one
+// receiving node).  Registry lookups are amortized: ONE lock
+// acquisition snapshots every referenced row (deduped by
+// (cid, sender_pos)), then all KEM decrypts + Horner evaluations run
+// outside the lock.  Per-item rc semantics are IDENTICAL to
+// hbe_dkg_ack_check (1 ok / 2 bad value / 0 bad ciphertext / -1 fall
+// back per item); u/v/w are flat count x 32-byte arrays, vals_out
+// likewise.  Returns 1, or 0 on gross misuse (caller falls back
+// entirely).
+int32_t hbe_dkg_ack_check_batch(const int64_t* cids,
+                                const int32_t* sender_pos, int32_t count,
+                                int32_t our_pos, const uint8_t* u_flat,
+                                const uint8_t* v_flat, const uint8_t* w_flat,
+                                const uint8_t* sk_be, int32_t* rc_out,
+                                uint8_t* vals_out) {
+  if (count < 1 || count > (1 << 22)) return 0;
+  std::vector<DkgRowCopy> uniq;
+  std::vector<int> ref((size_t)count, -1);
+  {
+    DkgRegistry& reg = dkg_registry();
+    std::lock_guard<std::mutex> lk(reg.mu);
+    std::map<std::pair<int64_t, int32_t>, int> seen;
+    for (int32_t i = 0; i < count; ++i) {
+      auto key = std::make_pair(cids[i], sender_pos[i]);
+      auto it = seen.find(key);
+      if (it != seen.end()) {
+        ref[i] = it->second;
+        continue;
+      }
+      int idx = (int)uniq.size();
+      uniq.push_back(dkg_copy_row(reg, cids[i], sender_pos[i]));
+      seen.emplace(key, idx);
+      ref[i] = idx;
+    }
+  }
+  // our_pos is fixed across the batch, so each distinct row's expected
+  // value is one Horner — not one per referencing ack.
+  std::vector<U256> expected(uniq.size(), U256_ZERO);
+  for (size_t k = 0; k < uniq.size(); ++k)
+    if (uniq[k].ok) expected[k] = dkg_row_eval(uniq[k], our_pos);
+  for (int32_t i = 0; i < count; ++i) {
+    const DkgRowCopy& rc = uniq[ref[i]];
+    if (!rc.ok) {
+      rc_out[i] = -1;
+      continue;
+    }
+    uint8_t plain[32];
+    if (!hbe_kem_decrypt(u_flat + 32 * (size_t)i, v_flat + 32 * (size_t)i, 32,
+                         w_flat + 32 * (size_t)i, sk_be, plain)) {
+      rc_out[i] = 0;
+      continue;
+    }
+    U256 val = u256_from_be(plain, 32);
+    if (!(u256_cmp(val, R_MOD) < 0)) {
+      rc_out[i] = 2;
+      continue;
+    }
+    if (!(mulmod(rc.g, val) == expected[ref[i]])) {
+      rc_out[i] = 2;
+      continue;
+    }
+    std::memcpy(vals_out + 32 * (size_t)i, plain, 32);
+    rc_out[i] = 1;
+  }
   return 1;
 }
 
@@ -3278,16 +3465,83 @@ int32_t hbe_dkg_ack_check(int64_t cid, int32_t sender_pos, int32_t our_pos,
 // to_bytes comparison), -1 unknown cid (caller falls back).
 int32_t hbe_dkg_row_check(int64_t cid, int32_t our_pos, const uint8_t* plain,
                           int32_t n_coeffs) {
-  DkgRegistry& reg = dkg_registry();
-  std::lock_guard<std::mutex> lk(reg.mu);
-  DkgCommit* c = dkg_get(reg, cid);
-  if (!c) return -1;
-  if (n_coeffs != c->n1) return 0;
-  const std::vector<U256>& row = dkg_row(*c, our_pos);
-  for (int j = 0; j < c->n1; ++j) {
+  DkgRowCopy rc;
+  {
+    DkgRegistry& reg = dkg_registry();
+    std::lock_guard<std::mutex> lk(reg.mu);
+    rc = dkg_copy_row(reg, cid, our_pos);
+  }
+  if (!rc.ok) return -1;
+  if (n_coeffs != rc.n1) return 0;
+  for (int j = 0; j < rc.n1; ++j) {
     U256 v = u256_from_be(plain + 32 * j, 32);
     if (!(u256_cmp(v, R_MOD) < 0)) return 0;
-    if (!(mulmod(c->g, v) == row[j])) return 0;
+    if (!(mulmod(rc.g, v) == rc.row[j])) return 0;
+  }
+  return 1;
+}
+
+// Batched Part private check (sync_key_gen._decrypt_row in one call per
+// batch): for each part, KEM-decrypt our encrypted row (v_flat holds
+// count ciphertext bodies of n1*32 bytes each), range-check the n1
+// decoded coefficients, and compare g*coeff_j against the registered
+// commitment's row(our_pos) — the exact decrypt -> _decode_scalars ->
+// row-consistency pipeline.  Registry lookups amortize through ONE
+// lock acquisition (deduped by cid; our_pos is fixed), checks run
+// outside it.  Per-item rc: 1 ok (rows_out[i] = the decrypted n1*32
+// plaintext), 2 ciphertext valid but decode/consistency failed
+// (-> fault), 0 the ciphertext itself failed the KEM check (-> fault;
+// distinct so the caller's ct-validity memo stays faithful), -1 unknown
+// cid (caller falls back per item).  Returns 1, or 0 on gross misuse.
+int32_t hbe_dkg_part_check_batch(const int64_t* cids, int32_t count,
+                                 int32_t our_pos, const uint8_t* u_flat,
+                                 const uint8_t* v_flat,
+                                 const uint8_t* w_flat, int32_t n1,
+                                 const uint8_t* sk_be, int32_t* rc_out,
+                                 uint8_t* rows_out) {
+  if (count < 1 || count > (1 << 22) || n1 < 1 || n1 > 4096) return 0;
+  size_t vlen = (size_t)n1 * 32;
+  std::vector<DkgRowCopy> uniq;
+  std::vector<int> ref((size_t)count, -1);
+  {
+    DkgRegistry& reg = dkg_registry();
+    std::lock_guard<std::mutex> lk(reg.mu);
+    std::map<int64_t, int> seen;
+    for (int32_t i = 0; i < count; ++i) {
+      auto it = seen.find(cids[i]);
+      if (it != seen.end()) {
+        ref[i] = it->second;
+        continue;
+      }
+      int idx = (int)uniq.size();
+      uniq.push_back(dkg_copy_row(reg, cids[i], our_pos));
+      seen.emplace(cids[i], idx);
+      ref[i] = idx;
+    }
+  }
+  for (int32_t i = 0; i < count; ++i) {
+    const DkgRowCopy& rc = uniq[ref[i]];
+    if (!rc.ok) {
+      rc_out[i] = -1;
+      continue;
+    }
+    uint8_t* plain = rows_out + vlen * (size_t)i;
+    if (!hbe_kem_decrypt(u_flat + 32 * (size_t)i, v_flat + vlen * (size_t)i,
+                         vlen, w_flat + 32 * (size_t)i, sk_be, plain)) {
+      rc_out[i] = 0;
+      continue;
+    }
+    if (rc.n1 != n1) {  // registered degree mismatch: same fault as the
+      rc_out[i] = 2;    // per-item row_check's n_coeffs != n1 verdict
+      continue;
+    }
+    int ok = 1;
+    for (int j = 0; j < n1 && ok; ++j) {
+      U256 v = u256_from_be(plain + 32 * (size_t)j, 32);
+      if (!(u256_cmp(v, R_MOD) < 0) || !(mulmod(rc.g, v) == rc.row[j]))
+        ok = 0;
+    }
+    rc_out[i] = ok ? 1 : 2;
   }
   return 1;
 }
@@ -3474,6 +3728,103 @@ int64_t hbe_serde_scan(const uint8_t* data, uint64_t len, int64_t* out,
   return (int64_t)s.n;
 }
 
+// --- vectorized Lagrange interpolation / combine ---------------------------
+//
+// The era-change batch tail's last Python-bigint stage: SyncKeyGen
+// generate() interpolates f(0) once per complete proposal, and the
+// scalar-suite PublicKeySet combines run the same Lagrange sum per
+// signature/decryption.  These mirror crypto/poly.py interpolate()
+// EXACTLY (same num/den products mod r, same f(0) value), batched so
+// one C call covers a whole generate() / combine.
+
+// sum over `n_groups` groups of interpolate_at_0(group) mod r — ONE
+// call for SyncKeyGen.generate()'s per-proposal interpolations (the
+// secret share is the sum) or, with n_groups = 1, a plain Lagrange
+// combine.  xs: flat positive evaluation points; ys_be: flat 32-byte BE
+// values < r; counts[g]: points in group g.  All denominators across
+// every group share ONE Fermat inversion (the Montgomery batch trick of
+// poly.lagrange_coefficients — a per-point invmod at 255 squarings each
+// measured SLOWER than CPython's extended-gcd pow(-1)).  The sum equals
+// poly.interpolate's per-group value exactly (same products mod r).
+// Returns 1 and fills out32, or 0 when the modulus is not this build's
+// R_MOD / a point is invalid / a denominator is zero (caller falls back
+// to the Python path — never a silent wrong value).
+int32_t hbe_scalar_interp_sum(const int32_t* xs, const uint8_t* ys_be,
+                              const int32_t* counts, int32_t n_groups,
+                              const uint8_t* r_be, uint8_t* out32) {
+  if (n_groups < 1 || n_groups > (1 << 20)) return 0;
+  if (!(u256_from_be(r_be, 32) == R_MOD)) return 0;
+  const U256 one = {{1, 0, 0, 0}};
+  size_t total = 0;
+  for (int32_t g = 0; g < n_groups; ++g) {
+    if (counts[g] < 1 || counts[g] > 65536) return 0;
+    total += (size_t)counts[g];
+  }
+  // Pass 1: per-point Lagrange numerator/denominator products.
+  std::vector<U256> nums(total), dens(total), ys(total);
+  {
+    const int32_t* gx = xs;
+    const uint8_t* gy = ys_be;
+    size_t base = 0;
+    for (int32_t g = 0; g < n_groups; ++g) {
+      int32_t cnt = counts[g];
+      for (int32_t k = 0; k < cnt; ++k) {
+        if (gx[k] <= 0) return 0;
+        ys[base + k] = u256_from_be(gy + 32 * (size_t)k, 32);
+        if (!(u256_cmp(ys[base + k], R_MOD) < 0)) return 0;
+        U256 num = one, den = one;
+        U256 xk = {{(uint64_t)gx[k], 0, 0, 0}};
+        for (int32_t j = 0; j < cnt; ++j) {
+          if (j == k) continue;
+          U256 xj = {{(uint64_t)gx[j], 0, 0, 0}};
+          num = mulmod(num, xj);
+          den = mulmod(den, submod(xj, xk));
+        }
+        if (u256_is_zero(den)) return 0;  // duplicate x: fall back
+        nums[base + k] = num;
+        dens[base + k] = den;
+      }
+      gx += cnt;
+      gy += (size_t)cnt * 32;
+      base += (size_t)cnt;
+    }
+  }
+  // Pass 2: one shared inversion, then accumulate y*num*den^-1.
+  std::vector<U256> prefix(total + 1);
+  prefix[0] = one;
+  for (size_t i = 0; i < total; ++i)
+    prefix[i + 1] = mulmod(prefix[i], dens[i]);
+  U256 inv_acc = invmod(prefix[total]);
+  U256 acc = U256_ZERO;
+  for (size_t i = total; i-- > 0;) {
+    U256 dinv = mulmod(inv_acc, prefix[i]);
+    inv_acc = mulmod(inv_acc, dens[i]);
+    acc = addmod(acc, mulmod(mulmod(ys[i], nums[i]), dinv));
+  }
+  u256_to_be32(acc, out32);
+  return 1;
+}
+
+// Scalar-suite combine_decryption_shares in one call: Lagrange-combine
+// the shares at 0, then unmask v with kdf(canonical(b"kem", acc)) —
+// byte-identical to keys.PublicKeySet.combine_decryption_shares over
+// ScalarSuite (the kdf/canonical framing is the shared scalar-KEM
+// code the equivalence suites already pin).  Returns 1 and fills
+// out[v_len], or 0 (caller falls back).
+int32_t hbe_scalar_combine_unmask(const int32_t* xs, int32_t count,
+                                  const uint8_t* ys_be, const uint8_t* r_be,
+                                  const uint8_t* v, uint64_t v_len,
+                                  uint8_t* out) {
+  uint8_t acc_be[32];
+  if (!hbe_scalar_interp_sum(xs, ys_be, &count, 1, r_be, acc_be)) return 0;
+  Bytes seed;
+  canon_append(seed, "kem");
+  canon_append(seed, Bytes((const char*)acc_be, 32));
+  Bytes mask = kdf_stream(seed, v_len);
+  for (uint64_t i = 0; i < v_len; ++i) out[i] = v[i] ^ (uint8_t)mask[i];
+  return 1;
+}
+
 // Row evaluations for ack building (Poly.eval at x = 1..n_points):
 // coeffs_be = n_coeffs 32-byte BE scalars (ascending degree), out =
 // n_points * 32 bytes.
@@ -3506,6 +3857,8 @@ void* hbe_create(int32_t n, int32_t f) {
   e->f = f;
   e->nodes.resize(n);
   for (int i = 0; i < n; ++i) e->nodes[i].id = i;
+  const char* g = getenv("HBBFT_TPU_CT_HASH_CACHE");
+  e->ct_hash_cache = !(g && g[0] == '0' && !g[1]);
   return e;
 }
 

@@ -155,6 +155,8 @@ inline uint8_t gf_inv(uint8_t a) { return gf().exp[255 - gf().log[a]]; }
 // out[r][c] ^= sum over i of a[r][i]*b[i][c]  (dims m x k @ k x n)
 inline void gf_matmul(const uint8_t* a, const uint8_t* b, uint8_t* out,
                       size_t m, size_t k, size_t n) {
+  if (!m || !n) return;  // empty shards: memset/memcpy on a null
+                         // vector data() is UB even at size 0
   std::memset(out, 0, m * n);
   for (size_t r = 0; r < m; ++r) {
     for (size_t i = 0; i < k; ++i) {

@@ -252,3 +252,59 @@ def test_encrypt_rng_stream_unchanged_by_fast_path():
         K._KEM_CACHE.pop(suite.name, None)
     assert r1.getstate() == r2.getstate()
     assert (ct_a.u, ct_a.v, ct_a.w) == (ct_b.u, ct_b.v, ct_b.w)
+
+
+def test_native_combine_matches_pure_and_rejects_oversized_indices():
+    """The scalar combine fast path must be value-identical to the pure
+    Lagrange path, and indices that would TRUNCATE in a ctypes c_int32
+    array (no OverflowError — verified behavior) must fall back to the
+    pure path instead of combining at a silently wrong point."""
+    import os
+    import random
+
+    from hbbft_tpu.crypto import keys as K
+    from hbbft_tpu.crypto.suite import ScalarSuite
+
+    suite = ScalarSuite()
+    rng = random.Random(9)
+    sks = K.SecretKeySet.random(1, rng, suite)
+    pks = sks.public_keys()
+    msg = b"combine-parity"
+
+    def pure(fn, *a):
+        os.environ["HBBFT_TPU_DKG_BATCH"] = "0"
+        try:
+            return fn(*a)
+        finally:
+            del os.environ["HBBFT_TPU_DKG_BATCH"]
+
+    # ordinary indices: fast == pure
+    shares = {i: sks.secret_key_share(i).sign(msg) for i in (0, 1)}
+    assert (
+        pks.combine_signatures(shares).to_bytes()
+        == pure(pks.combine_signatures, shares).to_bytes()
+    )
+    ct = pks.public_key().encrypt(b"plain" * 20, rng)
+    dshares = {i: sks.secret_key_share(i).decryption_share(ct) for i in (0, 1)}
+    assert pks.combine_decryption_shares(dshares, ct) == pure(
+        pks.combine_decryption_shares, dshares, ct
+    )
+
+    # an index past int32: x = i + 1 would truncate in the C call; the
+    # fast path must defer so both paths agree ((i + 1) % r Lagrange).
+    big = 2**32 + 2
+    shares_big = {
+        big: sks.secret_key_share(big).sign(msg),
+        1: sks.secret_key_share(1).sign(msg),
+    }
+    assert (
+        pks.combine_signatures(shares_big).to_bytes()
+        == pure(pks.combine_signatures, shares_big).to_bytes()
+    )
+    dshares_big = {
+        big: sks.secret_key_share(big).decryption_share(ct),
+        1: dshares[1],
+    }
+    assert pks.combine_decryption_shares(dshares_big, ct) == pure(
+        pks.combine_decryption_shares, dshares_big, ct
+    )

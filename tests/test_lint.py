@@ -466,12 +466,19 @@ def test_engine_patched_unreset_proposal_field_flagged(engine_src):
     assert any("sneaky_counter" in x.message for x in f)
 
 
-def test_engine_patched_free_slot_write_flagged(engine_src):
-    patched = engine_src.replace(
-        "e.prof_count[14]++;",
-        "e.prof_count[14]++;\n        e.prof_cycles[12] += dt;",
+def test_engine_patched_free_slot_write_flagged(engine_src, monkeypatch):
+    # Every slot is claimed as of round 6 (12/15 = batch/contrib wall),
+    # so simulate releasing slot 12: the claim-before-stamp rule must
+    # then flag the engine's existing slot-12 stamps as unclaimed.
+    from tools.lint import cxxlints
+
+    monkeypatch.setattr(
+        cxxlints,
+        "CLAIMED_SLOTS",
+        {k: v for k, v in cxxlints.CLAIMED_SLOTS.items() if k != 12},
     )
-    f = [x for x in lint_source(patched) if x.rule == "HBC004"]
+    monkeypatch.setattr(cxxlints, "FREE_SLOTS", frozenset({12}))
+    f = [x for x in lint_source(engine_src) if x.rule == "HBC004"]
     assert any("slot 12" in x.message for x in f)
 
 

@@ -153,6 +153,107 @@ def test_ext_scalar_era_change():
     assert base[2] >= 1  # the era actually advanced
 
 
+def _drive_era_change_n16():
+    """One N=16 era change on the engine; returns (batch keys, faults,
+    era, per-node new-era key material)."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import Change as Chg
+
+    n = 16
+    nat = native_engine.NativeQhbNet(
+        n, seed=7, batch_size=BATCH_SIZE, num_faulty=0, session_id=SESSION
+    )
+    keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+    keep.pop(n - 1)
+    for nid in range(n):
+        nat.send_input(nid, Input.change(Chg.node_change(keep)))
+
+    def done(e):
+        return all(
+            any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+            for i in e.correct_ids
+        )
+
+    rounds = 0
+    while not done(nat) and rounds < 12:
+        for nid in range(n):
+            nat.send_input(nid, Input.user(f"e{rounds}-{nid}"))
+        rounds += 1
+        nat.run_until(
+            lambda e, w=rounds: all(
+                len(e.nodes[i].outputs) >= w for i in e.correct_ids
+            ),
+            chunk=1,
+        )
+    assert done(nat)
+    out = {
+        i: [batch_key(b) for b in nat.nodes[i].outputs] for i in nat.correct_ids
+    }
+    faults = {i: nat.faults(i) for i in range(n)}
+    era = nat.nodes[0].qhb.dhb.era
+    keysets = {}
+    for i in nat.correct_ids:
+        ni = nat.nodes[i].qhb.dhb.netinfo
+        sk = ni.secret_key_share
+        keysets[i] = (
+            ni.public_key_set.to_bytes(),
+            sk.x if sk is not None else None,
+        )
+    nat.close()
+    return out, faults, era, keysets
+
+
+def test_era_change_native_batch_matches_pure_python_dkg(monkeypatch):
+    """The tentpole's byte-identity pin: a FULL N=16 era change with the
+    round-6 native batch-digest DKG path vs the same run with the
+    sync_key_gen native plane disabled (pure-Python oracle throughout;
+    same seed).  Committed batches, fault logs, eras AND the generated
+    key sets must be identical."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    if skg_mod._native_dkg(ScalarSuite()) is None:
+        pytest.skip("native DKG unavailable")
+
+    skg_mod.PREDIGEST_STATS.update(items=0, hits=0)
+    base = _drive_era_change_n16()
+    assert skg_mod.PREDIGEST_STATS["hits"] > 0, "batch digest never engaged"
+    assert base[2] >= 1  # the era actually advanced
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(skg_mod, "_NATIVE_DKG", {ScalarSuite().name: None})
+        pure = _drive_era_change_n16()
+    assert base == pure
+
+
+def test_era_change_per_item_fallback_fuzz(monkeypatch):
+    """Per-item fallback under fire: every 3rd batched ack check
+    reports a stale cid AND part digests are disabled entirely — the
+    era change must still commit the exact same batches/keys (the
+    misses fall through the per-item native path to the oracle)."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    nd = skg_mod._native_dkg(ScalarSuite())
+    if nd is None:
+        pytest.skip("native DKG unavailable")
+
+    base = _drive_era_change_n16()
+
+    orig = skg_mod._NativeDkg.ack_check_batch
+
+    def flaky(self, items, our_pos, sk_x):
+        res = orig(self, items, our_pos, sk_x)
+        if res is None:
+            return None
+        return [(-1, 0) if i % 3 == 0 else rv for i, rv in enumerate(res)]
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(skg_mod._NativeDkg, "ack_check_batch", flaky)
+        mp.setattr(
+            skg_mod._NativeDkg, "part_check_batch", lambda *a, **k: None
+        )
+        fuzzed = _drive_era_change_n16()
+    assert base == fuzzed
+
+
 # ---------------------------------------------------------------------------
 # Real BLS12-381 under the native loop
 # ---------------------------------------------------------------------------

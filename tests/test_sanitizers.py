@@ -61,6 +61,38 @@ keys = [
 ]
 assert all(k == keys[0] for k in keys), "correct nodes diverged"
 print("SANITIZED-EPOCH-OK")
+
+# A full era change drives the round-6 batch-digest entry points under
+# the sanitizer: hbe_dkg_ack_check_batch / hbe_dkg_part_check_batch
+# (registry copy-out + batched KEM/Horner), hbe_scalar_interp_sum /
+# hbe_scalar_combine_unmask, and the shared ct-hash cache.
+from hbbft_tpu.protocols.dynamic_honey_badger import Change
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+keep.pop(3)
+for i in nat.correct_ids:
+    nat.send_input(i, Input.change(Change.node_change(keep)))
+
+def era_done(e):
+    return all(
+        any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+        for i in e.correct_ids
+    )
+
+rounds = 1
+while not era_done(nat) and rounds < 12:
+    for i in nat.correct_ids:
+        nat.send_input(i, Input.user(("era-tx", rounds, i)))
+    rounds += 1
+    nat.run_until(
+        lambda e, w=rounds: all(
+            len(e.nodes[i].outputs) >= w for i in e.correct_ids
+        ),
+        chunk=1 if threads == 0 else 256,
+    )
+assert era_done(nat), "sanitized era change did not complete"
+print("SANITIZED-ERA-OK")
 """
 
 
@@ -121,6 +153,7 @@ def test_asan_native_epoch():
     )
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "SANITIZED-ERA-OK" in res.stdout
     assert "AddressSanitizer" not in res.stderr
 
 
@@ -129,6 +162,7 @@ def test_ubsan_native_epoch():
     res = _drive(lib, _runtime("libubsan.so"), {})
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "SANITIZED-ERA-OK" in res.stdout
     assert "runtime error" not in res.stderr
 
 
@@ -143,4 +177,5 @@ def test_tsan_multithread_epoch():
     )
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
+    assert "SANITIZED-ERA-OK" in res.stdout
     assert "WARNING: ThreadSanitizer" not in res.stderr

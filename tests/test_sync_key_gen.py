@@ -337,6 +337,178 @@ def test_native_dkg_fast_path_matches_pure_python(monkeypatch):
     assert r_pure == r_nat
 
 
+def test_native_batch_predigest_matches_pure_python(monkeypatch):
+    """The round-6 batch-digest path (predigest_batch -> one C call per
+    batch, consumed by handle_part/handle_ack) must be byte-identical to
+    the pure-Python oracle: same rng stream, same ack values, same fault
+    outcomes, same generated keys — including per-item fallbacks for a
+    tampered value, a broken ciphertext, and an OVERSIZED value slot
+    (which the digest must skip, not mis-verify)."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+    from hbbft_tpu.crypto.keys import Ciphertext
+
+    nd = skg_mod._native_dkg(SUITE)
+    if nd is None:
+        pytest.skip("native engine unavailable")
+
+    def run(batched: bool):
+        if batched:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: nd})
+        else:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: None})
+        n, t = 5, 1
+        rng, sks, pks = _setup(n, seed=29)
+        nodes, parts = {}, {}
+        for i in range(n):
+            skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+            nodes[i] = skg
+            parts[i] = part
+        transcripts = []
+        part_msgs = [(d, parts[d]) for d in sorted(parts)]
+        if batched:  # predigest draws NO rng: streams stay aligned
+            for i in range(n):
+                nodes[i].predigest_batch(part_msgs)
+        acks = []
+        for d, part in part_msgs:
+            for i in range(n):
+                out = nodes[i].handle_part(d, part, rng)
+                transcripts.append((i, d, out.fault))
+                if out.ack is not None:
+                    acks.append((i, out.ack))
+                    for ct in out.ack.values:
+                        transcripts.append((ct.u.value, ct.v, ct.w.value))
+        for i in range(n):
+            nodes[i].clear_predigest()
+        # Tampers: wrong value under a VALID ciphertext, a broken
+        # ciphertext, and an oversized (64-byte) value slot.
+        s0, a0 = acks[0]
+        vals = list(a0.values)
+        vals[2] = pks[2].encrypt(b"\x00" * 31 + b"\x05", rng)
+        acks[0] = (s0, Ack(a0.proposer, tuple(vals)))
+        s1, a1 = acks[1]
+        ct1 = a1.values[3]
+        vals1 = list(a1.values)
+        vals1[3] = Ciphertext(ct1.u, ct1.v, ct1.u, SUITE)  # w = u: invalid
+        acks[1] = (s1, Ack(a1.proposer, tuple(vals1)))
+        s2, a2 = acks[2]
+        vals2 = list(a2.values)
+        vals2[1] = pks[1].encrypt(b"\x00" * 64, rng)  # oversized slot
+        acks[2] = (s2, Ack(a2.proposer, tuple(vals2)))
+        if batched:
+            for i in range(n):
+                nodes[i].predigest_batch(acks)
+        for sender, ack in acks:
+            for i in range(n):
+                out = nodes[i].handle_ack(sender, ack)
+                transcripts.append((i, sender, ack.proposer, out.fault))
+        for i in range(n):
+            nodes[i].clear_predigest()
+        results = {}
+        for i in range(n):
+            pk_set, share = nodes[i].generate()
+            results[i] = (pk_set.to_bytes(), share.x)
+            transcripts.append(sorted(nodes[i].proposals[0].values.items()))
+        return transcripts, results
+
+    skg_mod.PREDIGEST_STATS.update(items=0, hits=0)
+    t_bat, r_bat = run(batched=True)
+    assert skg_mod.PREDIGEST_STATS["hits"] > 0, "digest path never engaged"
+    t_pure, r_pure = run(batched=False)
+    assert t_bat == t_pure
+    assert r_bat == r_pure
+
+
+def test_predigest_per_item_fallback_on_stale_cid(monkeypatch):
+    """Fuzz the native-miss path: some batched checks report -1 (stale
+    cid) AND the registry generation bumps between digest and handling —
+    every miss must fall back per item (with the one-shot re-register)
+    and the generated keys must equal the pure-Python run's."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    nd = skg_mod._native_dkg(SUITE)
+    if nd is None:
+        pytest.skip("native engine unavailable")
+
+    orig = skg_mod._NativeDkg.ack_check_batch
+
+    def flaky(self, items, our_pos, sk_x):
+        res = orig(self, items, our_pos, sk_x)
+        if res is None:
+            return None
+        return [(-1, 0) if i % 3 == 0 else rv for i, rv in enumerate(res)]
+
+    def run(batched: bool):
+        if batched:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: nd})
+            monkeypatch.setattr(skg_mod._NativeDkg, "ack_check_batch", flaky)
+        else:
+            monkeypatch.setattr(skg_mod, "_NATIVE_DKG", {SUITE.name: None})
+        n, t = 4, 1
+        rng, sks, pks = _setup(n, seed=37)
+        nodes, parts = {}, {}
+        for i in range(n):
+            skg, part = SyncKeyGen.new(i, sks[i], pks, t, rng, SUITE)
+            nodes[i] = skg
+            parts[i] = part
+        part_msgs = [(d, parts[d]) for d in sorted(parts)]
+        acks = []
+        for d, part in part_msgs:
+            for i in range(n):
+                out = nodes[i].handle_part(d, part, rng)
+                assert out.is_valid
+                if out.ack is not None:
+                    acks.append((i, out.ack))
+        if batched:
+            for i in range(n):
+                nodes[i].predigest_batch(acks)
+            # generation bump strands every memoized cid: the per-item
+            # fallback must take the refresh path, never a fault.
+            nd._lib.hbe_dkg_clear()
+        for sender, ack in acks:
+            for i in range(n):
+                assert nodes[i].handle_ack(sender, ack).is_valid
+        for i in range(n):
+            nodes[i].clear_predigest()
+        return {
+            i: (nodes[i].generate()[0].to_bytes(), nodes[i].generate()[1].x)
+            for i in range(n)
+        }
+
+    assert run(batched=True) == run(batched=False)
+
+
+def test_stale_cid_refresh_reregisters():
+    """ADVICE round 5: a registry generation bump must not strand a
+    live commitment on the slow path — the first rc == -1 clears the
+    memo and re-registers once, after which the fast path works."""
+    import hbbft_tpu.protocols.sync_key_gen as skg_mod
+
+    nd = skg_mod._native_dkg(SUITE)
+    if nd is None:
+        pytest.skip("native engine unavailable")
+    rng, sks, pks = _setup(4, seed=41)
+    nodes = {}
+    parts = {}
+    for i in range(4):
+        skg, part = SyncKeyGen.new(i, sks[i], pks, 1, rng, SUITE)
+        nodes[i] = skg
+        parts[i] = part
+    ack = nodes[0].handle_part(1, parts[1], rng).ack
+    assert ack is not None
+    nodes[2].handle_part(1, parts[1], rng)
+    cid_before = parts[1].commitment.__dict__.get("_native_cid")
+    assert cid_before is not None and cid_before >= 0
+    nd._lib.hbe_dkg_clear()
+    out = nodes[2].handle_ack(0, ack)
+    assert out.is_valid
+    cid_after = parts[1].commitment.__dict__.get("_native_cid")
+    assert cid_after is not None and cid_after >= 0
+    assert cid_after != cid_before  # re-registered under the new generation
+    assert int(nd._lib.hbe_dkg_registry_size()) >= 1
+    # and the value actually landed via the refreshed fast path
+    assert 1 in nodes[2].proposals[1].values
+
+
 def test_native_dkg_registry_bounded_and_generation_safe():
     """One registration per distinct commitment (memoized on the shared
     object); hbe_dkg_clear bumps the generation so STALE cids fall back

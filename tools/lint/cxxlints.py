@@ -44,6 +44,8 @@ MUTEX_FOR = {
     "decoded_order": "cache_mu",
     "mask_by_acc": "cache_mu",
     "mask_order": "cache_mu",
+    "ct_hash_by_payload": "cache_mu",
+    "ct_hash_order": "cache_mu",
     "cur_batch": "cb_mu",
 }
 

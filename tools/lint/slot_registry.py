@@ -10,7 +10,9 @@ silently corrupt an existing profile) and on claimed slots that no
 longer appear (stale claims hide genuinely free slots).
 
 History: round 4 claimed 11/13/14; round-5 cleanup returned 12/15 to
-the free pool (CLAUDE.md perf-state notes).
+the free pool; round 6 claimed both for the era-change batch-tail
+split (batch_cb / contrib_cb wall — the before/after measurement for
+the native batch-digest fast path).
 """
 
 # Dynamic range: prof_cycles[ty] / prof_count[ty], ty = MsgType 0..10.
@@ -19,9 +21,11 @@ TYPED_DELIVERY_SLOTS = frozenset(range(0, 11))
 # Literal-index claims: slot -> owner/purpose.
 CLAIMED_SLOTS = {
     11: "continuation max cycles (engine_flush_pool tail split, round 4)",
+    12: "Python batch_cb wall cycles (commit_events, round 6 batch-digest A/B)",
     13: "continuation tail >1M cycles (engine_flush_pool, round 4)",
     14: "pool-flush continuation total (engine_flush_pool, round 4)",
+    15: "Python contrib_cb wall cycles (hb_accept_plaintext decode split, round 6)",
 }
 
 # Free for temporary instrumentation: claim here before stamping.
-FREE_SLOTS = frozenset({12, 15})
+FREE_SLOTS = frozenset()
