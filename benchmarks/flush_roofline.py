@@ -22,6 +22,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -41,6 +42,39 @@ from hbbft_tpu.crypto.tpu import backend as tb  # noqa: E402
 
 def _block(tree) -> None:
     jax.block_until_ready(tree)
+
+
+def _relay_backed_tpu() -> bool:
+    """True on the axon relay-backed TPU platform (CLAUDE.md env
+    gotchas): the one real chip is registered through a local relay by
+    the axon plugin, which pins JAX_PLATFORMS=axon.  The AOT
+    ``.lower().compile()`` path that cost_analysis needs bypasses the
+    persistent-cache fast path there and WEDGED a round-5 battery step
+    at 2700 s — so the cost stage defaults OFF on that platform."""
+    if "axon" in (os.environ.get("JAX_PLATFORMS") or ""):
+        return True
+    try:
+        return any(
+            getattr(d, "platform", "") in ("axon", "tpu") for d in jax.devices()
+        )
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def _skip_cost() -> Optional[str]:
+    """Reason to skip the cost_analysis stage, or None to run it.
+    ROOFLINE_SKIP_COST stays the explicit override in both directions:
+    "1" forces the skip anywhere, "0" forces the stage even on the
+    relay platform."""
+    env = os.environ.get("ROOFLINE_SKIP_COST")
+    if env is not None:
+        return "ROOFLINE_SKIP_COST=1" if env not in ("", "0") else None
+    if _relay_backed_tpu():
+        return (
+            "relay-backed TPU platform (lower+compile wedged at 2700 s "
+            "round 5; set ROOFLINE_SKIP_COST=0 to force)"
+        )
+    return None
 
 
 def _cost(fn, *args) -> dict:
@@ -107,12 +141,14 @@ def main() -> None:
 
     # Cost analysis on the compiled kernels for these buckets, lowered
     # from the exact production inputs (_scan_prep is the same host prep
-    # _scan_dev dispatches with).  ROOFLINE_SKIP_COST=1 skips it — the
-    # AOT lower+compile path can recompile outside the persistent-cache
-    # fast path on the relay-backed TPU platform.
+    # _scan_dev dispatches with).  Skipped BY DEFAULT on the relay-backed
+    # TPU platform (_skip_cost notes); ROOFLINE_SKIP_COST overrides in
+    # either direction.
     costs = {}
-    if os.environ.get("ROOFLINE_SKIP_COST"):
+    skip_reason = _skip_cost()
+    if skip_reason is not None:
         costs["skipped"] = True
+        costs["skip_reason"] = skip_reason
     else:
         try:
             buckets, args = backend._scan_prep(reqs[: backend.CHUNK])

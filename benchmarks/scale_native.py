@@ -8,8 +8,27 @@ honest AND bounded, each N gets a full epoch if it fits the budget,
 else a steady-state delivery-rate measurement over a fixed window with
 the epoch time EXTRAPOLATED (flagged as such in the JSON).
 
+Round 7: the JSON line carries per-message-type cyc/delivery
+(``hbe_prof_cycles``/``hbe_prof_count``) plus the RLC group stats, so
+the COIN/DECRYPT RLC A/B is one run per arm instead of hand-read
+profiling slots:
+
+    HBBFT_TPU_COIN_RLC=0 python benchmarks/scale_native.py   # old path
+    HBBFT_TPU_COIN_RLC=1 python benchmarks/scale_native.py   # RLC arm
+
+Compare ``cyc_per_delivery`` back-to-back on a quiet box (the counters
+are rdtsc-based, but invariant-TSC cycles per instruction still swing
+with the clock state — alternate the arms and compare pairs, CLAUDE.md
+clock-drift rules).  The RLC arm runs the deferred scalar cadence at
+``SCALE_FLUSH_EVERY`` (default 5000 — the measured N=300 optimum:
+smaller windows pay per-flush overhead, larger ones thrash the
+delivery caches and lag BA rounds; 0 = queue-dry measured WORSE at
+N=300, BASELINE.md round 7).  The old path is eager-only, so the knob
+is ignored there.
+
 Env: SCALE_NS (comma list, default "300,512"), SCALE_BUDGET_S per N
-(default 5400), SCALE_WINDOW (rate-window deliveries, default 30M).
+(default 5400), SCALE_WINDOW (rate-window deliveries, default 30M),
+SCALE_FLUSH_EVERY (RLC arm only; default 5000).
 """
 
 from __future__ import annotations
@@ -26,8 +45,14 @@ from hbbft_tpu.protocols.queueing_honey_badger import Input
 
 
 def run_n(n: int, budget_s: float, window: int) -> dict:
+    rlc_on = os.environ.get("HBBFT_TPU_COIN_RLC", "1") != "0"
+    fe_env = os.environ.get("SCALE_FLUSH_EVERY")
+    flush_every = int(fe_env) if fe_env is not None else (5000 if rlc_on else 1)
     t0 = time.perf_counter()
-    nat = native_engine.NativeQhbNet(n, seed=0, batch_size=8)
+    nat = native_engine.NativeQhbNet(
+        n, seed=0, batch_size=8,
+        flush_every=flush_every if rlc_on else 1,
+    )
     setup_s = time.perf_counter() - t0
     for nid in nat.correct_ids:
         nat.send_input(nid, Input.user(f"tx{nid}"))
@@ -41,6 +66,8 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
         "nodes": n,
         "suite": "scalar",
         "rbc_codec": "gf2^16" if n > 255 else "gf256",
+        "rlc": rlc_on,
+        "flush_every": nat.flush_every,
         "setup_s": round(setup_s, 2),
     }
     chunk = 2_000_000
@@ -76,6 +103,24 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
             break
     faults = sum(len(nat.faults(i)) for i in nat.correct_ids)
     rec["correct_node_faults"] = faults
+    # Per-message-type cyc/delivery (the RLC A/B readout).  The engine
+    # folds deferred-flush verification + continuation cycles back into
+    # COIN/DECRYPT and re-attributes replayed future messages and
+    # epoch-boundary work to their own slots (engine_flush_pool /
+    # Engine::replay_borrow), so the two arms' numbers compare the
+    # actual share-path work.
+    prof = nat.prof_stats()
+    rec["cyc_per_delivery"] = {
+        name: round(s["cycles"] / s["count"], 1)
+        for name, s in prof.items()
+        if name in native_engine.NativeQhbNet.MSG_TYPE_NAMES and s["count"]
+    }
+    rec["prof_counts"] = {
+        name: prof[name]["count"]
+        for name in native_engine.NativeQhbNet.MSG_TYPE_NAMES
+        if prof[name]["count"]
+    }
+    rec["rlc_groups"] = prof["rlc_groups"]
     nat.close()
     return rec
 

@@ -21,8 +21,15 @@ Scope: int node ids 0..N-1, no adversary (FIFO delivery, silent
 crash-faulty nodes).  Two crypto configurations:
 
 * **ScalarSuite (native)** — the engine computes the scalar-suite
-  checks itself with an eager flush; protocol-plane benchmark
-  configuration (BASELINE configs 3/4).
+  checks itself; protocol-plane benchmark configuration (BASELINE
+  configs 3/4).  Round 7: COIN/DECRYPT share checks are verified per
+  Ts/Td instance GROUP with one random-linear-combination check at the
+  pool flush (``HBBFT_TPU_COIN_RLC=0`` / ``rlc=False`` restores the
+  per-share submit-time path), and ``flush_every`` now also governs the
+  scalar cadence when RLC is on — 1 keeps the pre-round-7 per-unit
+  flush points byte-for-byte, 0 defers to queue-dry for maximal
+  grouping with identical protocol outputs and fault sets (the
+  deferred-verification invariant; tests/test_native_rlc.py).
 * **External crypto (round 3)** — any real :class:`Suite` (BLS12-381):
   group elements travel through the engine as opaque bytes; signing,
   combining and ciphertext parsing call back into Python per instance,
@@ -229,6 +236,7 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
         _CT_PARSE_CB,
     ]
     lib.hbe_set_flush_every.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_set_rlc.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.hbe_set_pre_crank.argtypes = [ctypes.c_void_p, _PRE_CRANK_CB]
     lib.hbe_queue_swap.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
@@ -560,6 +568,7 @@ class NativeQhbNet:
         external_crypto: Optional[bool] = None,
         adversary: Any = None,
         threads: int = 1,
+        rlc: Optional[bool] = None,
     ) -> None:
         lib = get_lib(_words_for(n))
         if lib is None:
@@ -599,6 +608,33 @@ class NativeQhbNet:
         )
         if not self.ext and not isinstance(suite, ScalarSuite):
             raise ValueError("native-scalar mode requires ScalarSuite")
+        # Scalar RLC deferred verification (round 7): group COIN/DECRYPT
+        # share checks at flush instead of per-share mulmods at submit.
+        # Default from HBBFT_TPU_COIN_RLC (on unless "0"); the kwarg
+        # overrides.  flush_every now also governs the SCALAR flush
+        # cadence when RLC is on (1 = the pre-round-7 per-unit flush
+        # points exactly; 0 = flush on queue-dry — maximal grouping,
+        # identical protocol outputs by the deferred-verification
+        # invariant, pinned by tests/test_native_rlc.py).
+        self.rlc = (
+            bool(rlc)
+            if rlc is not None
+            else os.environ.get("HBBFT_TPU_COIN_RLC", "1") != "0"
+        )
+        self.flush_every = flush_every
+        if not self.ext and flush_every != 1:
+            if not self.rlc:
+                raise ValueError(
+                    "scalar flush_every != 1 requires the RLC deferred "
+                    "path (rlc=True / HBBFT_TPU_COIN_RLC=1); the legacy "
+                    "per-share path only flushes per unit"
+                )
+            if self.threads > 1:
+                raise ValueError(
+                    "threads > 1 requires flush_every=1 in scalar mode "
+                    "(the deferred scalar flush cadence is a sequential "
+                    "ordering, like external crypto's)"
+                )
         rng = random.Random(seed)
         sks = SecretKeySet.random(f, rng, suite)
         pks = sks.public_keys()
@@ -613,6 +649,10 @@ class NativeQhbNet:
 
         self.handle = lib.hbe_create(n, f)
         assert self.handle
+        if rlc is not None:
+            lib.hbe_set_rlc(self.handle, 1 if self.rlc else 0)
+        if not self.ext and flush_every != 1:
+            lib.hbe_set_flush_every(self.handle, flush_every)
         # keep callback objects alive for the engine's lifetime
         self._batch_cb = _BATCH_CB(self._on_batch)
         self._contrib_cb = _CONTRIB_CB(self._on_contrib)
@@ -1135,6 +1175,40 @@ class NativeQhbNet:
     @property
     def pending_verifies(self) -> int:
         return int(self.lib.hbe_pending_verifies(self.handle))
+
+    # Engine MsgType names for the typed delivery profiling slots 0..10
+    # (native/engine.cpp enum MsgType order).
+    MSG_TYPE_NAMES = (
+        "VALUE", "ECHO", "READY", "ECHO_HASH", "CAN_DECODE",
+        "BVAL", "AUX", "CONF", "COIN", "TERM", "DECRYPT",
+    )
+
+    def prof_stats(self) -> Dict[str, Dict[str, int]]:
+        """Delivery profiling counters: per-message-type cycles/counts
+        (slots 0..10) plus the claimed literal slots by registry name
+        (tools/lint/slot_registry.py).  Under the deferred RLC cadence
+        the engine folds flush-side continuation cycles back into the
+        COIN/DECRYPT typed slots, so ``cycles/count`` stays an honest
+        cyc/delivery across the HBBFT_TPU_COIN_RLC A/B."""
+        lib, h = self.lib, self.handle
+        out: Dict[str, Dict[str, int]] = {}
+        for i, name in enumerate(self.MSG_TYPE_NAMES):
+            out[name] = {
+                "cycles": int(lib.hbe_prof_cycles(h, i)),
+                "count": int(lib.hbe_prof_count(h, i)),
+            }
+        for slot, name in (
+            (11, "rlc_groups"),
+            (12, "batch_cb"),
+            (13, "epoch_advance"),
+            (14, "pool_flush"),
+            (15, "contrib_cb"),
+        ):
+            out[name] = {
+                "cycles": int(lib.hbe_prof_cycles(h, slot)),
+                "count": int(lib.hbe_prof_count(h, slot)),
+            }
+        return out
 
     def _raise_cb_error(self) -> None:
         if self._cb_error is not None:

@@ -15,6 +15,16 @@ Lagrange+unmask entry point (``hbe_scalar_combine_unmask``, round 6):
 the per-epoch combine of a DKG-sized ciphertext — Lagrange sum plus a
 kdf stream over hundreds of KB — was part of the measured era-change
 batch tail, and is byte-identical through either path.
+
+Native-engine mirror (round 7): the engine batch-verifies each flush's
+pending decryption shares of one instance with a single RLC check —
+``Σ rᵢ·shareᵢ·H(ct) == (Σ rᵢ·pkᵢ)·ct.w`` — bisecting failed groups so
+bad shares get the same :data:`FAULT_INVALID_SHARE` attribution as this
+per-share path (``HBBFT_TPU_COIN_RLC=0`` restores per-share checks;
+tests/test_native_rlc.py pins the matrix).  Changes to the acceptance
+rules here (buffering, the terminated gate, fault timing) must be
+mirrored in ``native/engine.cpp``'s ``td_verified_cb`` AND
+``td_group_verified_cb``.
 """
 
 from __future__ import annotations

@@ -11,6 +11,21 @@ of the combined signature).
 TPU-first deviation: share verification is *deferred* — submitted to the
 :class:`~hbbft_tpu.crypto.pool.VerifySink` and counted only once the batch
 flush confirms it (SURVEY.md §7 "deferred-verify queue").
+
+Native-engine mirror (round 7): over the scalar suite the engine
+additionally batch-verifies each flush's pending shares of one
+ThresholdSign instance with a single random-linear-combination check —
+``Σ rᵢ·shareᵢ == (Σ rᵢ·pkᵢ)·H(doc)`` with small nonzero engine-PRNG
+coefficients — bisecting a failed group down to per-share checks so a
+bad share yields the same :data:`FAULT_INVALID_SHARE` against the same
+sender as this per-share path.  That is an *optimization inside the
+verify step*, never a semantics change: protocol outputs and fault
+attribution must stay identical to verifying each share individually
+(``HBBFT_TPU_COIN_RLC=0`` restores per-share verification; the matrix is
+pinned by tests/test_native_rlc.py, invariant in docs/INVARIANTS.md).
+Any change to the acceptance rules here (who is counted, when faults
+fire, the terminated gate) must be mirrored in ``native/engine.cpp``'s
+``ts_verified_cb`` AND ``ts_group_verified_cb``.
 """
 
 from __future__ import annotations

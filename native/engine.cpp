@@ -326,20 +326,31 @@ inline Bytes kdf_stream(const Bytes& seed, size_t n) {
 // index set: every node combining the same (FIFO-typical) first-t+1
 // index set otherwise pays the modular inverse + O(k^2) mulmods again —
 // the single hottest share of the N=64 era-change combines.
-inline std::vector<U256> lagrange_cached(const std::vector<int>& idxs);
+inline std::shared_ptr<const std::vector<U256>> lagrange_cached(
+    const std::vector<int>& idxs);
 
 inline std::vector<U256> lagrange(const std::vector<int>& idxs) {
   size_t k = idxs.size();
   std::vector<U256> xs(k), nums(k), dens(k), coeffs(k);
   for (size_t i = 0; i < k; ++i) xs[i] = {{(uint64_t)(idxs[i] + 1), 0, 0, 0}};
+  // nums via prefix/suffix products: num_i = Π_{j!=i} x_j in O(k)
+  // (the old per-i inner loop was half the O(k^2) mulmods of a miss —
+  // at t+1 = 100 a cache miss was ~2.7M cycles, round-7 combine
+  // profile).  dens keep the O(k^2) loop: each factor depends on i.
+  {
+    std::vector<U256> pre(k + 1), suf(k + 1);
+    pre[0] = {{1, 0, 0, 0}};
+    suf[k] = {{1, 0, 0, 0}};
+    for (size_t i = 0; i < k; ++i) pre[i + 1] = mulmod(pre[i], xs[i]);
+    for (size_t i = k; i-- > 0;) suf[i] = mulmod(suf[i + 1], xs[i]);
+    for (size_t i = 0; i < k; ++i) nums[i] = mulmod(pre[i], suf[i + 1]);
+  }
   for (size_t i = 0; i < k; ++i) {
-    U256 num = {{1, 0, 0, 0}}, den = {{1, 0, 0, 0}};
+    U256 den = {{1, 0, 0, 0}};
     for (size_t j = 0; j < k; ++j) {
       if (j == i) continue;
-      num = mulmod(num, xs[j]);
       den = mulmod(den, submod(xs[j], xs[i]));
     }
-    nums[i] = num;
     dens[i] = den;
   }
   // batch inversion
@@ -355,14 +366,17 @@ inline std::vector<U256> lagrange(const std::vector<int>& idxs) {
   return coeffs;
 }
 
-inline std::vector<U256> lagrange_cached(const std::vector<int>& idxs) {
-  // Returns by VALUE under a mutex: multicore workers share this cache,
-  // and a reference could be invalidated by a concurrent eviction (the
-  // old single-thread version returned a reference and evicted one
-  // entry FIFO to keep callers' references alive — by-value removes
-  // that aliasing subtlety entirely; the copy is t+1 scalars).
+inline std::shared_ptr<const std::vector<U256>> lagrange_cached(
+    const std::vector<int>& idxs) {
+  // Returns a shared_ptr under a mutex: multicore workers share this
+  // cache, and a raw reference could be invalidated by a concurrent
+  // eviction.  The round-6 by-value form closed that hole with a full
+  // t+1-scalar copy per COMBINE (~3 KB alloc+copy on the per-epoch
+  // coin path — measurable in the round-7 combine profile); the
+  // shared_ptr keeps eviction-safety without the copy.
   static std::mutex mu;
-  static std::map<std::vector<int>, std::vector<U256>> cache;
+  static std::map<std::vector<int>,
+                  std::shared_ptr<const std::vector<U256>>> cache;
   static std::deque<std::vector<int>> order;
   std::lock_guard<std::mutex> lk(mu);
   auto it = cache.find(idxs);
@@ -371,7 +385,10 @@ inline std::vector<U256> lagrange_cached(const std::vector<int>& idxs) {
       cache.erase(order.front());
       order.pop_front();
     }
-    it = cache.emplace(idxs, lagrange(idxs)).first;
+    it = cache.emplace(
+               idxs,
+               std::make_shared<const std::vector<U256>>(lagrange(idxs)))
+             .first;
     order.push_back(idxs);
   }
   return it->second;
@@ -631,6 +648,12 @@ struct Sbv {
 
 struct Ts {
   U256 doc_h;  // hash_to_g2(doc) (scalar mode)
+  // Open RLC group cursor (scalar deferred mode): pool index of this
+  // instance's leader Pending, valid iff grp_round == Node::pool_round
+  // (each flush swap-round opens fresh groups).  Ts/Td are PER-NODE
+  // objects, so these fields are worker-local under engine_run_mt.
+  uint64_t grp_round = 0;
+  int32_t grp_idx = -1;
   Bytes doc;   // the signed document (external-crypto mode: hashed Python-side)
   NodeSet seen;
   std::vector<std::pair<int, U256>> verified;  // insertion order (scalar)
@@ -646,6 +669,9 @@ struct Ts {
 // ===========================================================================
 
 struct Td {
+  // Open RLC group cursor — see Ts::grp_round.
+  uint64_t grp_round = 0;
+  int32_t grp_idx = -1;
   bool has_ct = false;
   ScalarCiphertext ct;
   U256 ct_h = U256_ZERO;  // hash_to_g2 of ct hash input
@@ -892,6 +918,20 @@ struct VReq {
   std::shared_ptr<const Bytes> share;  // VK_SIG/VK_DEC: wire share bytes
 };
 
+// One share of a submit-time RLC group (round 7, scalar deferred
+// mode): the leader Pending of a Ts/Td instance holds ALL of that
+// instance's shares for the current flush round as a CONTIGUOUS array
+// — the flush verifies and folds them with streaming reads instead of
+// sweeping one 200+-byte Pending per share through a cold pool (the
+// N=300 first-cut regression: the per-share round-trip's DRAM misses
+// cost more than the mulmods the RLC removed).
+struct RlcShare {
+  U256 share;
+  U256 pk;  // submit-time snapshot (Pending::pk note applies)
+  int32_t sender;
+  uint8_t ok;  // verdict, written by the flush's group check
+};
+
 // Flat continuation (round 4): COIN/DECRYPT deliveries dominated the
 // full-epoch cycle profile (~2.4k cycles each vs ~400 for BVAL/AUX),
 // largely the std::function continuation each pool entry heap-allocated
@@ -902,13 +942,23 @@ enum ContKind : uint8_t { CONT_TS = 0, CONT_TD_CT = 1, CONT_TD_SHARE = 2 };
 struct Pending {
   bool need_verdict = false;  // true: external mode, verdict from flush cb
   bool pre_ok = false;        // scalar mode: verdict computed at submit
+  bool rlc_defer = false;     // scalar RLC mode: verdict computed by the
+                              // flush's group pass (scalar_rlc_verdicts)
   uint8_t cont = CONT_TS;
   int32_t era = 0, epoch = 0, proposer = 0, rnd = 0, sender = -1;
   VReq req;
   std::shared_ptr<Ts> ts;    // CONT_TS (keeps req.doc alive)
   std::shared_ptr<Td> td;    // CONT_TD_* (keeps req.ct alive)
   U256 share = U256_ZERO;    // scalar-mode share
+  U256 pk = U256_ZERO;       // scalar RLC mode: sender's pk share,
+                             // SNAPSHOTTED at submit — an era restart
+                             // (batch cb) can replace node.pk_shares
+                             // before a deferred verdict runs, and the
+                             // verdict must use the submitting era's key
+                             // exactly like the old submit-time check
   std::shared_ptr<const Bytes> share_b;  // ext-mode share
+  std::vector<RlcShare> grp;  // scalar deferred mode: the instance's
+                              // shares this flush round (leader only)
 };
 
 const int FUTURE_ERA_BUFFER = 4096;
@@ -930,6 +980,8 @@ struct Node {
   Hb hb;                // inline (see Hb.state note); valid iff hb_init
   bool hb_init = false;
   std::vector<Pending> pool;
+  bool pool_dirty = false;  // queued in Engine::dirty_nodes (deferred mode)
+  uint64_t pool_round = 1;  // bumped per flush swap-round (Ts::grp_round)
   std::vector<Pending> flush_scratch;  // engine_flush_pool drain buffer
   bool flushing = false;               // reentrancy guard for the scratch
   int suppress_emit = 0;  // scoped stale-callback guard (per node: the
@@ -1055,6 +1107,40 @@ struct Engine {
   // escape hatch for the payload pinning if memory ever matters more
   // than the recompute.
   bool ct_hash_cache = true;
+  // -- scalar RLC deferred verification (round 7) --------------------------
+  // COIN/DECRYPT share checks in scalar mode are deferred to the pool
+  // flush and verified per (Ts/Td instance) GROUP with one random-linear-
+  // combination check instead of one full-width mulmod per share
+  // (scalar_rlc_verdicts).  flush_every is shared with ext mode: scalar
+  // mode uses it when rlc is on (1 = eager per-unit flush, exactly the
+  // pre-round-7 flush points; 0 = flush on queue-dry — maximal grouping,
+  // identical protocol outputs by the deferred-verification invariant).
+  // HBBFT_TPU_COIN_RLC=0 (read at hbe_create; hbe_set_rlc overrides)
+  // restores the pre-round-7 path: submit-time verdicts, per-unit flush.
+  bool rlc = true;
+  // Dirty-node list for the deferred scalar flush (VirtualNet's
+  // _dirty_pools): a pool can only fill while its own node's handler
+  // or flush runs, so engine_flush_scalar visits exactly these instead
+  // of scanning all N nodes per flush (the scan bounded how small
+  // flush_every could usefully go).  Maintained ONLY under the
+  // deferred cadence, which is sequential — never touched by workers.
+  std::vector<int32_t> dirty_nodes;
+  // Replay re-attribution (round 7): future-round / future-epoch
+  // REPLAYS run inside whatever delivery or continuation advanced the
+  // round/epoch — without re-attribution their cycles inflate that
+  // message type's slot (a COIN continuation would be billed for whole
+  // replayed BVAL/AUX/CONF loads, in BOTH RLC arms).  The replay loops
+  // stamp each replayed message's own-time into its own typed slot and
+  // add it here; enclosing typed stamps subtract the delta.  Counts
+  // are NOT re-ticked (the original delivery ticked them when it
+  // buffered).  Single-writer: only touched under !mt_active guards.
+  uint64_t replay_borrow = 0;
+  // True while engine_flush_scalar drains deferred pools: those
+  // continuations run OUTSIDE engine_run's typed delivery stamp, so
+  // engine_flush_pool folds their cycles back into the delivering
+  // message type's slot (BA_COIN / HB_DECRYPT) to keep cyc/delivery
+  // comparable across the HBBFT_TPU_COIN_RLC A/B.
+  bool in_deferred_flush = false;
 };
 
 const size_t MASK_CACHE_MAX = 4096;
@@ -1066,9 +1152,230 @@ const size_t DECODED_ROOTS_MAX = 8192;
 // rather than the roomy counts of the byte-small caches above.
 const size_t CT_HASH_CACHE_MAX = 1024;
 
+// Scalar deferred-flush cadence active?  (Round 7: the RLC path shares
+// ext mode's flush_every machinery; 1 keeps the pre-round-7 per-unit
+// eager flush points exactly.)
+inline bool scalar_deferred(const Engine& e) {
+  return !e.ext && e.rlc && e.flush_every != 1;
+}
+
 inline void pool_push(Engine& e, Node& node, Pending&& p) {
   node.pool.push_back(std::move(p));
   e.pool_items++;
+  if (!node.pool_dirty && scalar_deferred(e)) {
+    node.pool_dirty = true;
+    e.dirty_nodes.push_back(node.id);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Scalar RLC group verification (round 7)
+//
+// Per-share check being amortized:   share_i == pk_i * H        (COIN)
+//                                    share_i * ct_h == pk_i * ct.w  (DECRYPT)
+// Group check over k pending shares of one Ts/Td instance, with small
+// nonzero 64-bit coefficients r_i from a deterministic splitmix chain
+// seeded per (instance hash, sub-range):
+//       Σ r_i*share_i == (Σ r_i*pk_i) * H          (resp. the two-sided
+//       Σ r_i*share_i * ct_h == (Σ r_i*pk_i) * ct.w decrypt form)
+// The Σ accumulators are UNREDUCED 512-bit integers (each term is a
+// 64x256 product; k < 2^191 cannot overflow 8 words), reduced once per
+// group through the existing Montgomery machinery — so the per-share
+// cost is one 4-limb widening mul + add per side (~7 cyc measured)
+// against a full Montgomery mulmod (~134 cyc) on the per-share path.
+//
+// Exactness: a group containing exactly one bad share can never pass
+// (r_i != 0 and the defect term r_i*δ_i is nonzero mod r); multiple
+// bad shares cancel only with probability ~2^-64 per check, and the
+// coefficients are re-drawn per bisection sub-range, so the recursion
+// terminates at per-item direct checks and attributes every bad share
+// to its sender exactly like the per-share path (the ScalarSuite is
+// the protocol-plane TEST suite — trivially forgeable by design — so
+// adversarial coefficient-grinding is out of scope; real crypto runs
+// the ext-mode backends).  docs/INVARIANTS.md "RLC byte-identity".
+// ---------------------------------------------------------------------------
+
+inline uint64_t rlc_mix(uint64_t z) {
+  z += 0x9e3779b97f4a7c15ULL;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// acc += a * r, acc an 8-word little-endian unreduced integer.
+inline void rlc_acc_mul(uint64_t acc[8], const U256& a, uint64_t r) {
+  unsigned __int128 c = 0;
+  for (int i = 0; i < 4; ++i) {
+    c += (unsigned __int128)a.w[i] * r + acc[i];
+    acc[i] = (uint64_t)c;
+    c >>= 64;
+  }
+  for (int i = 4; i < 8 && c; ++i) {
+    c += acc[i];
+    acc[i] = (uint64_t)c;
+    c >>= 64;
+  }
+}
+
+// 512-bit unreduced value mod r: redc gives T*2^-256 mod r (valid for
+// T < r*2^256, which k*2^319 accumulators satisfy for any feasible k);
+// multiplying by R2 = 2^512 mod r and reducing again restores T mod r.
+inline U256 rlc_reduce512(const uint64_t t[8]) {
+  U256 m = redc(t);
+  uint64_t t2[8];
+  u256_mul_raw(m, R2_MOD, t2);
+  return redc(t2);
+}
+
+// ---- Scalar RLC share verification: one core, two layouts ----------------
+//
+// The RLC math (coefficient chain, unreduced accumulators, bisection,
+// break-even thresholds) exists ONCE, templated over a layout view:
+//  * GrpView — the deferred cadence's contiguous RlcShare arrays on a
+//    leader Pending (submit-time groups);
+//  * CsrView — flush_every=1 bursts' per-share Pendings via CSR
+//    indices (scalar_rlc_verdicts).
+// A single implementation keeps the two cadences' verdict behavior
+// mechanically identical (the RLC byte-identity invariant's mirror
+// obligation, docs/INVARIANTS.md).
+
+// Per-instance check constants: TS verifies share == pk*h1 (h1 =
+// doc_h); TD verifies share*h1 == pk*h2 (h1 = ct_h, h2 = ct.w).
+struct RlcInstance {
+  bool is_ts;
+  const U256* h1;
+  const U256* h2;
+};
+
+inline RlcInstance rlc_instance(const Pending& p) {
+  if (p.cont == CONT_TS) return {true, &p.ts->doc_h, nullptr};
+  return {false, &p.td->ct_h, &p.td->ct.w};
+}
+
+inline uint64_t rlc_seed(const RlcInstance& in) {
+  const U256& h = *in.h1;
+  return rlc_mix(h.w[0] ^ rlc_mix(h.w[1] ^ rlc_mix(h.w[2] ^ h.w[3])));
+}
+
+// Exact per-share check — the same formulas the pre-round-7 submit
+// path computed, over the pk snapshot taken at submit.  The TS check
+// is REPRESENTATIONAL (`share == mulmod(pk, doc_h)`; mulmod output is
+// canonical), so a non-canonical wire encoding (value >= r, congruent
+// to the valid share) must fail here too — congruence alone would
+// accept it and diverge from the per-share path's fault log.  The TD
+// check is congruence on BOTH sides in the per-share path (the share
+// flows through mulmod), so non-canonical decrypt shares pass in both
+// paths alike; no extra gate there.
+inline bool rlc_eq(const RlcInstance& in, const U256& sh, const U256& pk) {
+  if (in.is_ts) {
+    if (u256_cmp(sh, R_MOD) >= 0) return false;
+    return sh == mulmod(pk, *in.h1);
+  }
+  return mulmod(sh, *in.h1) == mulmod(pk, *in.h2);
+}
+
+inline bool rlc_eq_acc(const RlcInstance& in, const uint64_t sh[8],
+                       const uint64_t pk[8]) {
+  if (in.is_ts)
+    return rlc_reduce512(sh) == mulmod(rlc_reduce512(pk), *in.h1);
+  return mulmod(rlc_reduce512(sh), *in.h1) ==
+         mulmod(rlc_reduce512(pk), *in.h2);
+}
+
+struct GrpView {
+  std::vector<RlcShare>& g;
+  const U256& share(size_t k) const { return g[k].share; }
+  const U256& pk(size_t k) const { return g[k].pk; }
+  int32_t sender(size_t k) const { return g[k].sender; }
+  void set_ok(size_t k, bool v) { g[k].ok = v ? 1 : 0; }
+};
+
+struct CsrView {
+  std::vector<Pending>& items;
+  const uint32_t* idxs;
+  const U256& share(size_t k) const { return items[idxs[k]].share; }
+  const U256& pk(size_t k) const { return items[idxs[k]].pk; }
+  int32_t sender(size_t k) const { return items[idxs[k]].sender; }
+  void set_ok(size_t k, bool v) { items[idxs[k]].pre_ok = v; }
+};
+
+// One RLC check over v[lo..hi).
+template <class V>
+inline bool rlc_check_range_v(const RlcInstance& in, const V& v, size_t lo,
+                              size_t hi, uint64_t seed) {
+  uint64_t acc_sh[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  uint64_t acc_pk[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  uint64_t state = rlc_mix(seed ^ (uint64_t)lo * 0xc2b2ae3d27d4eb4fULL ^
+                           (uint64_t)hi * 0x165667b19e3779f9ULL);
+  for (size_t k = lo; k < hi; ++k) {
+    // Non-canonical TS share in the range: the RLC sum only sees the
+    // residue, but the per-share check is representational (rlc_eq
+    // notes) — force the range to FAIL so bisection attributes it
+    // exactly.
+    if (in.is_ts && u256_cmp(v.share(k), R_MOD) >= 0) return false;
+    state = rlc_mix(state ^ v.share(k).w[0] ^
+                    ((uint64_t)(uint32_t)v.sender(k) << 32));
+    uint64_t r = state | 1;  // nonzero: a lone bad share can never cancel
+    rlc_acc_mul(acc_sh, v.share(k), r);
+    rlc_acc_mul(acc_pk, v.pk(k), r);
+  }
+  return rlc_eq_acc(in, acc_sh, acc_pk);
+}
+
+// Assign verdicts for v[lo..hi): group check, bisect on failure,
+// per-share direct checks at the leaves (exact attribution).
+template <class V>
+void rlc_assign_range_v(const RlcInstance& in, V& v, size_t lo, size_t hi,
+                        uint64_t seed) {
+  if (hi - lo == 1) {
+    v.set_ok(lo, rlc_eq(in, v.share(lo), v.pk(lo)));
+    return;
+  }
+  if (rlc_check_range_v(in, v, lo, hi, seed)) {
+    for (size_t k = lo; k < hi; ++k) v.set_ok(k, true);
+    return;
+  }
+  size_t mid = lo + (hi - lo) / 2;
+  rlc_assign_range_v(in, v, lo, mid, seed);
+  rlc_assign_range_v(in, v, mid, hi, seed);
+}
+
+template <class V>
+inline void rlc_verify_range_v(const RlcInstance& in, V& v, size_t lo,
+                               size_t hi) {
+  if (hi - lo < 3) {
+    // RLC breaks even around three shares (two accumulate muls + the
+    // group finalize vs one direct mulmod per share); below that the
+    // direct checks win.
+    for (size_t k = lo; k < hi; ++k)
+      v.set_ok(k, rlc_eq(in, v.share(k), v.pk(k)));
+  } else {
+    rlc_assign_range_v(in, v, lo, hi, rlc_seed(in));
+  }
+}
+
+inline bool rlc_check_one(const Pending& p) {
+  return rlc_eq(rlc_instance(p), p.share, p.pk);
+}
+
+// Lazy CHUNKED verification, driven by the folded continuations as
+// they consume shares: the per-share path never verifies shares whose
+// continuations would run after termination, so verifying a whole
+// accumulated group up front did strictly MORE crypto than the
+// per-share path (at N=300 a group holds ~2.5x the f+1 shares the
+// instance needs).  Chunks are RLC-checked with (lo,hi)-seeded
+// coefficients like bisection sub-ranges; verdict semantics are
+// unchanged (post-termination shares get no verdict and no fault in
+// BOTH paths).  Returns the new verified limit.
+const size_t RLC_CHUNK = 32;
+
+inline size_t lead_verify_chunk(Pending& lead, size_t lo) {
+  size_t hi = lo + RLC_CHUNK;
+  if (hi > lead.grp.size()) hi = lead.grp.size();
+  RlcInstance in = rlc_instance(lead);
+  GrpView v{lead.grp};
+  rlc_verify_range_v(in, v, lo, hi);
+  return hi;
 }
 
 // ===========================================================================
@@ -1404,10 +1711,36 @@ struct Ctx {
       p.req.doc = &ts->doc;  // Ts kept alive by p.ts
       p.req.share = p.share_b;
     } else {
-      // Deferred verification: compute the verdict now (order-independent
-      // scalar check), run the protocol callback at flush (pool order).
       p.share = m.share;
-      p.pre_ok = p.share == mulmod(node.pk_shares[sender], ts->doc_h);
+      if (e.rlc && scalar_deferred(e)) {
+        // Round-7 deferred RLC path: shares of one Ts accumulate as a
+        // CONTIGUOUS group on the instance's leader Pending (formed
+        // HERE, while the state is cache-hot); the flush verifies the
+        // whole group with one RLC check — Σ rᵢ·shareᵢ ==
+        // (Σ rᵢ·pkᵢ)·doc_h — bisecting failures so verdicts match the
+        // per-share path exactly (scalar_rlc docs / INVARIANTS.md).
+        if (ts->grp_round == node.pool_round && ts->grp_idx >= 0) {
+          node.pool[ts->grp_idx].grp.push_back(
+              {m.share, node.pk_shares[sender], sender, 0});
+          return;
+        }
+        p.rlc_defer = true;
+        p.grp.push_back({m.share, node.pk_shares[sender], sender, 0});
+        ts->grp_round = node.pool_round;
+        ts->grp_idx = (int32_t)node.pool.size();  // this push's index
+      } else if (e.rlc) {
+        // flush_every=1: per-share Pendings at the pre-round-7 flush
+        // points; the flush's verdict pass checks them (grouped only
+        // within one unit's burst), keeping runs byte-identical to the
+        // Python net.
+        p.rlc_defer = true;
+        p.pk = node.pk_shares[sender];
+      } else {
+        // Pre-round-7 path (HBBFT_TPU_COIN_RLC=0): compute the verdict
+        // now (order-independent scalar check), run the protocol
+        // callback at flush (pool order).
+        p.pre_ok = p.share == mulmod(node.pk_shares[sender], ts->doc_h);
+      }
     }
     pool_push(e, node, std::move(p));
   }
@@ -1451,6 +1784,50 @@ struct Ctx {
     if (!live_epoch) node.suppress_emit--;
   }
 
+  // Folded continuation for a deferred RLC GROUP of same-Ts shares
+  // (scalar deferred mode only): the inner TS._on_verified body runs
+  // per item in pool order, but the coin-scope/epoch lift — and the
+  // caller's commit_events — run once per group instead of once per
+  // share.  This is observably identical to running ts_verified_cb per
+  // item: pre-termination items' lifts are no-ops (no parity yet, no
+  // pending subset outputs/batches), and post-termination items are
+  // complete no-ops (the Python path records no fault after
+  // termination either), so only the single terminating item's lift
+  // has effects — and it runs here with the same state it would have
+  // seen per-item.  Fault order within the group is submission order,
+  // as in the per-share path.
+  void ts_group_verified_cb(int era, int epoch, int proposer, int rnd,
+                            const std::shared_ptr<Ts>& ts, Pending& lead) {
+    size_t count = lead.grp.size(), vlim = 0;
+    bool live_epoch = node.era == era && node.hb_init && node.hb.epoch == epoch;
+    if (!live_epoch) node.suppress_emit++;
+    std::vector<uint8_t> parity_out;
+    for (size_t k = 0; k < count; ++k) {
+      if (ts->terminated) break;  // later items are no-ops (see above)
+      if (k >= vlim) vlim = lead_verify_chunk(lead, k);
+      const RlcShare& sh = lead.grp[k];
+      if (!sh.ok) {
+        ops.fault(sh.sender, F_TS_INVALID);
+        continue;
+      }
+      ts->verified.push_back({sh.sender, sh.share});
+      ts->verified_set.add(sh.sender);
+      ts_try_output(*ts, parity_out);
+    }
+    if (live_epoch) {
+      EpochState& st = node.hb.state;
+      if (!parity_out.empty()) {
+        Ba& ba = st.proposals[proposer].ba;
+        if (ba.round == rnd && !ba.terminated && ba.coin == ts) {
+          for (uint8_t par : parity_out) ba_on_coin(st, proposer, ba, par);
+        }
+      }
+      hb_drain_subset_outputs(st);
+      hb_advance();
+    }
+    if (!live_epoch) node.suppress_emit--;
+  }
+
   void ts_try_output(Ts& ts, std::vector<uint8_t>& parity_out) {
     int threshold = f();
     size_t have = e.ext ? ts.verified_b.size() : ts.verified.size();
@@ -1477,14 +1854,21 @@ struct Ctx {
     }
     // by_index (netinfo.index) -> sorted, first threshold+1, combine.
     std::vector<std::pair<int, U256>> by_index;
+    by_index.reserve(ts.verified.size());
     for (auto& kv : ts.verified)
       by_index.push_back({node.val_index[kv.first], kv.second});
     std::sort(by_index.begin(), by_index.end(),
               [](auto& a, auto& b) { return a.first < b.first; });
     by_index.resize(threshold + 1);
     std::vector<int> idxs;
+    idxs.reserve(by_index.size());
     for (auto& kv : by_index) idxs.push_back(kv.first);
-    std::vector<U256> lam = lagrange_cached(idxs);
+    // Hold the shared_ptr for the whole sum: lifetime extension does
+    // NOT apply through the dereference of a temporary, and a
+    // concurrent cache eviction dropping the last refcount mid-sum
+    // would be a use-after-free under engine_run_mt.
+    std::shared_ptr<const std::vector<U256>> lam_p = lagrange_cached(idxs);
+    const std::vector<U256>& lam = *lam_p;
     U256 acc = U256_ZERO;
     for (size_t i = 0; i < by_index.size(); ++i)
       acc = addmod(acc, mulmod(lam[i], by_index[i].second));
@@ -1711,11 +2095,41 @@ struct Ctx {
     }
     sbv_input(st, proposer, ba.round, ba.sbv, ba.estimate == 1, outs);
     ba_consume_sbv(st, proposer, ba, outs);
-    // Replay buffered future-round messages.
+    // Replay buffered future-round messages, re-attributing each
+    // replayed message's cycles to its own type (Engine::replay_borrow).
     std::vector<std::pair<int, EMsg>> future;
     future.swap(ba.future);
     ba.future_count.clear();
-    for (auto& sm : future) ba_handle_message(st, proposer, ba, sm.first, sm.second);
+    if (!e.mt_active) {
+      // One tick per message (chained: each message's end is the next
+      // one's start) — a 2-rdtsc-per-replay version measurably taxed
+      // replay-heavy deferred cadences.
+      uint64_t t_prev = prof_tick();
+      for (auto& sm : future) {
+        if (e.in_deferred_flush && sm.second.type == BA_COIN) {
+          // A replayed coin share's own work (a group append) already
+          // lands in a COIN/DECRYPT continuation stamp: re-attribution
+          // would move cycles within the same slot class while paying
+          // a tick per message — skip it (the stamps exist for
+          // CROSS-type honesty: BVAL/AUX/CONF loads inside coin
+          // continuations).
+          ba_handle_message(st, proposer, ba, sm.first, sm.second);
+          t_prev = prof_tick();
+          continue;
+        }
+        uint64_t b0 = e.replay_borrow;
+        ba_handle_message(st, proposer, ba, sm.first, sm.second);
+        uint64_t inner = e.replay_borrow - b0;
+        uint64_t t_now = prof_tick();
+        uint64_t own = t_now - t_prev - inner;
+        t_prev = t_now;
+        e.prof_cycles[sm.second.type & 15] += own;
+        e.replay_borrow = b0 + inner + own;
+      }
+    } else {
+      for (auto& sm : future)
+        ba_handle_message(st, proposer, ba, sm.first, sm.second);
+    }
   }
 
   void ba_handle_term(EpochState& st, int proposer, Ba& ba, int sender,
@@ -1774,13 +2188,25 @@ struct Ctx {
     if (m.round < ba.round) return;  // stale: drop
     if (m.round > ba.round) {
       if (m.round - ba.round <= MAX_FUTURE_ROUNDS) {
-        // Per-sender counter instead of scanning the buffer: the linear
-        // scan was O(buffered) per future message (quadratic per round
-        // at churn when rounds lag across the network).
-        int& cnt = ba.future_count[sender];
-        if (cnt < 4 * MAX_FUTURE_ROUNDS) {
-          ++cnt;
+        // The per-sender cap (4 * MAX_FUTURE_ROUNDS) cannot bind while
+        // the WHOLE buffer holds fewer entries than the cap, so the
+        // honest path skips the per-sender map entirely (a map op per
+        // buffered share taxed the deferred RLC cadence, where rounds
+        // advance at flush and most coin traffic buffers).  Crossing
+        // the threshold rebuilds exact counts from the buffer — every
+        // entry was admitted unconditionally below it — so the drop
+        // decisions are identical to counting from the start.
+        size_t cap = (size_t)(4 * MAX_FUTURE_ROUNDS);
+        if (ba.future.size() < cap) {
           ba.future.push_back({sender, m});
+        } else {
+          if (ba.future_count.empty())
+            for (auto& sm : ba.future) ba.future_count[sm.first]++;
+          int& cnt = ba.future_count[sender];
+          if (cnt < (int)cap) {
+            ++cnt;
+            ba.future.push_back({sender, m});
+          }
         }
       }
       return;
@@ -2403,8 +2829,26 @@ struct Ctx {
     p.sender = sender;
     p.td = td;
     p.share = share;
-    p.pre_ok =
-        mulmod(share, td->ct_h) == mulmod(node.pk_shares[sender], td->ct.w);
+    if (e.rlc && scalar_deferred(e)) {
+      // Round-7 deferred RLC path (see ts_handle_share): submit-time
+      // group on the Td's leader Pending; flush check is the two-sided
+      // Σ rᵢ·shareᵢ·ct_h == (Σ rᵢ·pkᵢ)·ct_w.
+      if (td->grp_round == node.pool_round && td->grp_idx >= 0) {
+        node.pool[td->grp_idx].grp.push_back(
+            {share, node.pk_shares[sender], sender, 0});
+        return;
+      }
+      p.rlc_defer = true;
+      p.grp.push_back({share, node.pk_shares[sender], sender, 0});
+      td->grp_round = node.pool_round;
+      td->grp_idx = (int32_t)node.pool.size();
+    } else if (e.rlc) {
+      p.rlc_defer = true;
+      p.pk = node.pk_shares[sender];
+    } else {
+      p.pre_ok =
+          mulmod(share, td->ct_h) == mulmod(node.pk_shares[sender], td->ct.w);
+    }
     pool_push(e, node, std::move(p));
   }
 
@@ -2445,6 +2889,35 @@ struct Ctx {
         td->verified_set.add(sender);
         td_try_output(*td, plain_out);
       }
+    }
+    if (live) {
+      hb_on_decrypt_boundary(proposer, td, plain_out);
+      hb_advance();
+    }
+    if (!live) node.suppress_emit--;
+  }
+
+  // Folded continuation for a deferred RLC GROUP of same-Td decryption
+  // shares — the ThresholdDecrypt twin of ts_group_verified_cb (same
+  // no-op argument: pre-termination lifts see an empty plain_out and a
+  // valid ciphertext, post-termination items are skipped entirely).
+  void td_group_verified_cb(int era, int epoch, int proposer,
+                            const std::shared_ptr<Td>& td, Pending& lead) {
+    size_t count = lead.grp.size(), vlim = 0;
+    bool live = node.era == era && node.hb_init && node.hb.epoch == epoch;
+    if (!live) node.suppress_emit++;
+    std::vector<BytesP> plain_out;
+    for (size_t k = 0; k < count; ++k) {
+      if (td->terminated) break;
+      if (k >= vlim) vlim = lead_verify_chunk(lead, k);
+      const RlcShare& sh = lead.grp[k];
+      if (!sh.ok) {
+        ops.fault(sh.sender, F_TD_INVALID);
+        continue;
+      }
+      td->verified.push_back({sh.sender, sh.share});
+      td->verified_set.add(sh.sender);
+      td_try_output(*td, plain_out);
     }
     if (live) {
       hb_on_decrypt_boundary(proposer, td, plain_out);
@@ -2507,14 +2980,18 @@ struct Ctx {
       return;
     }
     std::vector<std::pair<int, U256>> by_index;
+    by_index.reserve(td.verified.size());
     for (auto& kv : td.verified)
       by_index.push_back({node.val_index[kv.first], kv.second});
     std::sort(by_index.begin(), by_index.end(),
               [](auto& a, auto& b) { return a.first < b.first; });
     by_index.resize(threshold + 1);
     std::vector<int> idxs;
+    idxs.reserve(by_index.size());
     for (auto& kv : by_index) idxs.push_back(kv.first);
-    std::vector<U256> lam = lagrange_cached(idxs);
+    // shared_ptr held across the sum — see ts_try_output's combine.
+    std::shared_ptr<const std::vector<U256>> lam_p = lagrange_cached(idxs);
+    const std::vector<U256>& lam = *lam_p;
     U256 acc = U256_ZERO;
     for (size_t i = 0; i < by_index.size(); ++i)
       acc = addmod(acc, mulmod(lam[i], by_index[i].second));
@@ -2734,7 +3211,25 @@ struct Ctx {
     Hb& hb = node.hb;
     while (hb.state.batch_emitted) {
       hb.epoch += 1;
-      hb_reset_state(hb.state, hb.epoch);
+      if (!e.mt_active) {
+        // Slot 13 (registry, round 7): epoch-advance wall — recycling
+        // the whole per-epoch state (N Proposal resets: map teardowns,
+        // container clears) plus N fresh coin setups (hash_to_g2 per
+        // proposer).  This belongs to no message type, yet it used to
+        // be billed to whichever COIN/DECRYPT delivery happened to
+        // complete the epoch — at N=300 it was ~2/3 of those slots'
+        // cycles (the bulk of the old >1M "continuation tail" this
+        // slot measured before round 7).  Borrowed out of the
+        // enclosing typed stamp like replays (Engine::replay_borrow).
+        uint64_t t0 = prof_tick();
+        hb_reset_state(hb.state, hb.epoch);
+        uint64_t dt = prof_tick() - t0;
+        e.prof_cycles[13] += dt;
+        e.prof_count[13]++;
+        e.replay_borrow += dt;
+      } else {
+        hb_reset_state(hb.state, hb.epoch);
+      }
       auto it = hb.future.find(hb.epoch);
       std::vector<std::pair<int, EMsg>> replay;
       if (it != hb.future.end()) {
@@ -2749,7 +3244,18 @@ struct Ctx {
           else
             hb.future_per_sender.erase(fit);
         }
-        hb_state_dispatch(sm.first, sm.second);
+        // typed re-attribution — see ba_next_round's replay loop
+        if (!e.mt_active) {
+          uint64_t t0 = prof_tick();
+          uint64_t b0 = e.replay_borrow;
+          hb_state_dispatch(sm.first, sm.second);
+          uint64_t inner = e.replay_borrow - b0;
+          uint64_t own = prof_tick() - t0 - inner;
+          e.prof_cycles[sm.second.type & 15] += own;
+          e.replay_borrow = b0 + inner + own;
+        } else {
+          hb_state_dispatch(sm.first, sm.second);
+        }
       }
     }
   }
@@ -2851,8 +3357,13 @@ struct Ctx {
         e.batch_cb_depth--;
         if (!e.mt_active) {
           if (e.batch_cb_depth == 0) {
-            e.prof_cycles[12] += prof_tick() - t0;
+            uint64_t dt = prof_tick() - t0;
+            e.prof_cycles[12] += dt;
             e.prof_count[12]++;
+            // Batch-boundary work is not share work: borrow it out of
+            // the enclosing typed stamp (Engine::replay_borrow), like
+            // the epoch-advance wall.
+            e.replay_borrow += dt;
           }
         }
       }
@@ -2863,6 +3374,132 @@ struct Ctx {
 // ===========================================================================
 // Top-level engine driving
 // ===========================================================================
+
+// Verify one CSR-indexed group (flush_every=1 bursts) through the
+// shared RLC core.
+inline void rlc_verify_group(std::vector<Pending>& items, const uint32_t* gi,
+                             size_t gs) {
+  RlcInstance in = rlc_instance(items[gi[0]]);
+  CsrView v{items, gi};
+  rlc_verify_range_v(in, v, 0, gs);
+}
+
+// Flat (CSR) group layout, reused across a flush's swap rounds: group
+// g's item indices are idx[start[g] .. start[g+1]) in pool order (the
+// per-group std::vector form paid one small heap alloc per group —
+// measurable against the mulmods being amortized).
+struct RlcGroups {
+  std::vector<int32_t> group_of;  // item -> group id, -1 = not deferred
+  std::vector<uint32_t> idx;      // item indices, grouped, pool order
+  std::vector<uint32_t> start;    // ngroups+1 offsets into idx
+  std::vector<std::pair<uintptr_t, int32_t>> table;  // ptr -> gid scratch
+  size_t ngroups = 0;
+  void reset() {
+    group_of.clear();
+    idx.clear();
+    start.clear();
+    ngroups = 0;
+  }
+  const uint32_t* items_of(size_t g) const { return idx.data() + start[g]; }
+  size_t size_of(size_t g) const { return start[g + 1] - start[g]; }
+  uint32_t leader_of(size_t g) const { return idx[start[g]]; }
+};
+
+// Group the drained items' deferred entries per Ts/Td instance (pool
+// order preserved within each group) and compute every verdict.  All
+// scratch lives in the caller's RlcGroups (stack-rooted per flush), so
+// this is safe from engine_run_mt workers without locks — the shared
+// inputs (pk_shares, doc_h/ct_h) are node-local or instance-pinned.
+// Used at flush_every=1 only: the deferred cadence forms groups at
+// SUBMIT time instead (Pending::grp) and never reaches this pass.
+void scalar_rlc_verdicts(Engine& e, std::vector<Pending>& items,
+                         RlcGroups& gr) {
+  uint32_t deferred = 0, first = 0;
+  for (uint32_t i = 0; i < items.size(); ++i) {
+    if (items[i].rlc_defer) {
+      if (!deferred) first = i;
+      ++deferred;
+    }
+  }
+  if (!deferred) return;
+  // Deferred flushes run outside engine_run's typed delivery stamp, so
+  // the group-check cycles are folded into the COIN/DECRYPT typed
+  // slots per group (same honesty rule as the continuation stamps in
+  // engine_flush_pool — without it the RLC arm's cyc/delivery would
+  // simply EXCLUDE its verification cost).  At flush_every=1 the pass
+  // runs inside the delivering unit's typed stamp already.
+  uint64_t coin_cyc = 0, dec_cyc = 0;
+  uint64_t t0 = prof_tick();
+  size_t ngroups = 0;
+  if (deferred == 1) {
+    // Fast path — the dominant case at flush_every=1 (one share per
+    // delivered message): no grouping scratch, just the direct check.
+    items[first].pre_ok = rlc_check_one(items[first]);
+    ngroups = 1;
+    uint64_t dt = prof_tick() - t0;
+    if (items[first].cont == CONT_TS)
+      coin_cyc = dt;
+    else
+      dec_cyc = dt;
+  } else {
+    gr.group_of.assign(items.size(), -1);
+    // Open-addressing map from instance pointer to group id (pools at
+    // queue-dry flushes hold thousands of items across hundreds of
+    // instances; a tree map's alloc-per-node is measurable there).
+    size_t cap = 1;
+    while (cap < (size_t)deferred * 2) cap <<= 1;
+    gr.table.assign(cap, {0, -1});
+    gr.start.assign(1, 0);  // reused as per-group counts below
+    for (uint32_t i = 0; i < items.size(); ++i) {
+      Pending& p = items[i];
+      if (!p.rlc_defer) continue;
+      uintptr_t key = p.cont == CONT_TS ? (uintptr_t)p.ts.get()
+                                        : (uintptr_t)p.td.get();
+      size_t slot = (size_t)rlc_mix(key) & (cap - 1);
+      while (gr.table[slot].first != 0 && gr.table[slot].first != key)
+        slot = (slot + 1) & (cap - 1);
+      if (gr.table[slot].first == 0) {
+        gr.table[slot] = {key, (int32_t)gr.start.size() - 1};
+        gr.start.push_back(0);
+      }
+      gr.group_of[i] = gr.table[slot].second;
+      gr.start[(size_t)gr.table[slot].second + 1]++;
+    }
+    ngroups = gr.ngroups = gr.start.size() - 1;
+    for (size_t g = 1; g <= ngroups; ++g) gr.start[g] += gr.start[g - 1];
+    gr.idx.resize(deferred);
+    {
+      // fill cursor per group, then restore start[] by shifting back
+      std::vector<uint32_t>& cur = gr.start;
+      for (uint32_t i = 0; i < items.size(); ++i) {
+        int32_t g = gr.group_of[i];
+        if (g >= 0) gr.idx[cur[(size_t)g]++] = i;
+      }
+      for (size_t g = ngroups; g > 0; --g) cur[g] = cur[g - 1];
+      cur[0] = 0;
+    }
+    for (size_t g = 0; g < ngroups; ++g) {
+      size_t gs = gr.size_of(g);
+      uint64_t g0 = prof_tick();
+      const uint32_t* gi = gr.items_of(g);
+      rlc_verify_group(items, gi, gs);
+      if (items[gi[0]].cont == CONT_TS)
+        coin_cyc += prof_tick() - g0;
+      else
+        dec_cyc += prof_tick() - g0;
+    }
+  }
+  if (!e.mt_active) {
+    // Slot 11 (registry: scalar RLC group stats): cycles = verdict-pass
+    // wall, count = groups checked (singletons included).
+    e.prof_cycles[11] += prof_tick() - t0;
+    e.prof_count[11] += ngroups;
+    if (e.in_deferred_flush) {
+      e.prof_cycles[BA_COIN] += coin_cyc;
+      e.prof_cycles[HB_DECRYPT] += dec_cyc;
+    }
+  }
+}
 
 // Flat-continuation dispatch (see Pending): the three verified-callback
 // targets, constructed without a per-entry std::function allocation.
@@ -2884,6 +3521,19 @@ void pending_run(Engine& e, Node& node, Pending& p, bool ok) {
   c.commit_events();
 }
 
+// Folded dispatch for one submit-time RLC group (scalar deferred
+// mode): one Ctx, one lift, one commit_events for the whole group.
+void pending_run_grp(Engine& e, Node& node, Pending& lead) {
+  Ctx c(e, node);
+  if (lead.cont == CONT_TS)
+    c.ts_group_verified_cb(lead.era, lead.epoch, lead.proposer, lead.rnd,
+                           lead.ts, lead);
+  else
+    c.td_group_verified_cb(lead.era, lead.epoch, lead.proposer, lead.td,
+                           lead);
+  c.commit_events();
+}
+
 void engine_flush_pool(Engine& e, Node& node) {
   // Scalar mode.  Same swap-rounds semantics as always (a nested flush
   // — batch callback proposing into a nested engine_unit — sees only
@@ -2897,29 +3547,87 @@ void engine_flush_pool(Engine& e, Node& node) {
   std::vector<Pending> local;
   std::vector<Pending>& items = outer ? node.flush_scratch : local;
   if (outer) node.flushing = true;
+  // Group continuations are folded ONLY under the deferred cadence:
+  // at flush_every=1 the per-item dispatch keeps the continuation
+  // stream byte-identical to the Python VirtualNet's (the fidelity
+  // contract); deferred flushes are pinned at the output level instead
+  // (tests/test_native_rlc.py), where the fold is observationally
+  // equivalent (ts_group_verified_cb notes).
+  bool fold = scalar_deferred(e);
+  RlcGroups gr;
   while (!node.pool.empty()) {
     items.swap(node.pool);
+    // New swap-round: open groups on the old pool are now sealed (the
+    // submit sites key off pool_round — Ts::grp_round notes).
+    node.pool_round++;
     e.pool_items -= items.size();
-    for (Pending& p : items) {
+    gr.reset();
+    if (e.rlc && !e.ext && !fold) scalar_rlc_verdicts(e, items, gr);
+    for (uint32_t i = 0; i < items.size(); ++i) {
+      Pending& p = items[i];
       uint64_t t0 = prof_tick();
-      pending_run(e, node, p, p.pre_ok);
+      uint64_t b0 = e.replay_borrow;  // lint: st-only (read; guarded writes)
+      if (fold && p.rlc_defer) {
+        // Submit-time group: verdicts are streamed off the contiguous
+        // grp array in chunks AS the folded continuation consumes
+        // shares (lead_verify_chunk) — shares past termination are
+        // never verified, exactly like the per-share path.
+        pending_run_grp(e, node, p);
+        if (!e.mt_active) {
+          // Slot 11 (registry): groups dispatched; chunk-check cycles
+          // are inside the continuation stamp (slot 14 + typed).
+          e.prof_count[11]++;
+          e.prof_cycles[11] += prof_tick() - t0;
+        }
+      } else {
+        pending_run(e, node, p, p.pre_ok);
+      }
       if (!e.mt_active) {  // profiling counters are single-writer only
         uint64_t dt = prof_tick() - t0;
         e.prof_cycles[14] += dt;
         e.prof_count[14]++;
-        // Continuation tail split (era-change diagnosis, CLAUDE.md r4):
-        // slot 13 tallies continuations costing > 1M cycles (the
-        // big-payload decrypt/decode events); slot 11 keeps the max.
-        if (dt > 1000000) {
-          e.prof_cycles[13] += dt;
-          e.prof_count[13]++;
+        if (e.in_deferred_flush) {
+          // Deferred flushes run outside engine_run's typed delivery
+          // stamp: fold the verification + continuation cycles back
+          // into the delivering message type so COIN/DECRYPT
+          // cyc/delivery stays comparable across the HBBFT_TPU_COIN_RLC
+          // A/B (counts are already ticked at delivery; cycles only
+          // here).  Own-time only — replays inside the continuation
+          // stamped their own types (Engine::replay_borrow).
+          uint64_t own = dt - (e.replay_borrow - b0);
+          if (p.cont == CONT_TS)
+            e.prof_cycles[BA_COIN] += own;
+          else if (p.cont == CONT_TD_SHARE)
+            e.prof_cycles[HB_DECRYPT] += own;
         }
-        if (dt > e.prof_cycles[11]) e.prof_cycles[11] = dt;
       }
     }
     items.clear();
   }
   if (outer) node.flushing = false;
+}
+
+// Deferred-cadence scalar flush: drain every node's pool in sorted-id
+// order, in rounds (continuations may refill any pool) — the scalar
+// twin of engine_flush_ext / VirtualNet._flush_all_pools.
+void engine_flush_scalar(Engine& e) {
+  if (e.in_flush) return;  // re-entrancy (a propose inside a batch cb)
+  e.in_flush = true;
+  e.in_deferred_flush = true;
+  e.since_flush = 0;
+  std::vector<int32_t> batch;
+  while (!e.dirty_nodes.empty()) {
+    batch.swap(e.dirty_nodes);
+    std::sort(batch.begin(), batch.end());
+    for (int32_t nid : batch) {
+      Node& node = e.nodes[nid];
+      node.pool_dirty = false;  // re-pushes during the flush re-queue it
+      if (!node.pool.empty()) engine_flush_pool(e, node);
+    }
+    batch.clear();
+  }
+  e.in_deferred_flush = false;
+  e.in_flush = false;
 }
 
 // External-crypto flush: mirrors VirtualNet._flush_all_pools — visit
@@ -2947,17 +3655,24 @@ void engine_flush_ext(Engine& e) {
 }
 
 // Python's VirtualNet increments its flush counter once per delivered
-// message / top-level input; flushing resets it.
+// message / top-level input; flushing resets it.  Round 7: the scalar
+// RLC deferred cadence ticks the same counter (engine_flush_scalar in
+// place of the ext verify-batch flush).
 inline void engine_count_unit(Engine& e) {
-  if (!e.ext || e.in_flush) return;
+  if (e.in_flush) return;
+  if (!e.ext && !scalar_deferred(e)) return;
   e.since_flush++;
   if (e.flush_every > 0 && e.since_flush >= (uint64_t)e.flush_every) {
     // Python's _flush_all_pools resets the counter even when no pool is
     // dirty; skip the N-node scan in that (overwhelmingly common) case.
-    if (e.pool_items > 0)
-      engine_flush_ext(e);
-    else
+    if (e.pool_items > 0) {
+      if (e.ext)
+        engine_flush_ext(e);
+      else
+        engine_flush_scalar(e);
+    } else {
       e.since_flush = 0;
+    }
   }
 }
 
@@ -2992,13 +3707,20 @@ void engine_flush_ext_node(Engine& e, Node& node) {
 
 void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
   // One top-level processing unit: handler, then batch events, then the
-  // eager pool flush (each flush callback fires its own events).
+  // eager pool flush (each flush callback fires its own events).  Under
+  // the scalar deferred cadence (round 7) pools accumulate across units
+  // and drain via engine_flush_scalar — except tampered nodes, whose
+  // own pool always drains eagerly (VirtualNet's TamperingAdversary
+  // flushes the faulty node inside _drive, independent of cadence).
   e.depth++;
   Ctx ctx(e, node);
   fn(ctx);
   ctx.commit_events();
-  if (!e.ext) engine_flush_pool(e, node);
-  else if (node.tampered) engine_flush_ext_node(e, node);
+  if (!e.ext) {
+    if (!scalar_deferred(e) || node.tampered) engine_flush_pool(e, node);
+  } else if (node.tampered) {
+    engine_flush_ext_node(e, node);
+  }
   e.depth--;
 }
 
@@ -3020,7 +3742,11 @@ void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
 //     callback order is output-invariant).
 //   * Deliveries to the SAME node run in their original queue order on
 //     one worker, preserving each node's exact sequential transition
-//     sequence (scalar-mode pool flushes are per-unit and node-local).
+//     sequence (scalar-mode pool flushes are per-unit and node-local;
+//     the round-7 RLC verdict pass runs inside that per-unit flush with
+//     STACK-LOCAL group accumulators/scratch over node-local inputs, so
+//     workers never share RLC state — the deferred cadence itself
+//     (flush_every != 1) is sequential-only and hbe_run_mt falls back).
 //   * Each delivery's emissions are captured in its own slot and
 //     spliced back in SOURCE-DELIVERY ORDER — exactly the order the
 //     sequential loop would have appended them.
@@ -3099,8 +3825,11 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     if (e.queue.empty()) {
       // Idle: drain deferred verifications so progress can resume
       // (VirtualNet.crank's empty-queue flush).
-      if (e.ext && e.pool_items > 0 && !e.in_flush) {
-        engine_flush_ext(e);
+      if ((e.ext || scalar_deferred(e)) && e.pool_items > 0 && !e.in_flush) {
+        if (e.ext)
+          engine_flush_ext(e);
+        else
+          engine_flush_scalar(e);
         if (!e.queue.empty()) continue;
       }
       break;
@@ -3118,11 +3847,14 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     if (!node.tampered) e.delivered++;
     node.handled++;
     uint64_t t0 = prof_tick();
+    uint64_t b0 = e.replay_borrow;  // lint: st-only (sequential driver)
     engine_unit(e, node,
                 [&](Ctx& ctx) { ctx.deliver(item.sender, *item.msg); });
     int ty = item.msg->type & 15;
+    // Own-time only: replayed future messages inside this unit already
+    // stamped their own typed slots (Engine::replay_borrow).
     // lint: st-only (engine_run is the sequential driver, never a worker)
-    e.prof_cycles[ty] += prof_tick() - t0;
+    e.prof_cycles[ty] += prof_tick() - t0 - (e.replay_borrow - b0);
     e.prof_count[ty] += 1;
     if (!node.tampered) engine_count_unit(e);
   }
@@ -3196,7 +3928,7 @@ inline DkgCommit* dkg_get(DkgRegistry& reg, int64_t cid) {
 
 // By-value snapshot of one registered commitment's data for a given
 // evaluation point: everything the ack/row checks need OUTSIDE the
-// registry mutex (the by-value lagrange_cached pattern — the KEM
+// registry mutex (snapshot-outside-the-lock — the KEM
 // decrypt + Horner evaluations must not serialize all concurrent DKG
 // checks process-wide; ctypes drops the GIL, so multi-threaded Python
 // callers otherwise contend on the one global lock).
@@ -3369,7 +4101,7 @@ int32_t hbe_dkg_ack_check(int64_t cid, int32_t sender_pos, int32_t our_pos,
                           const uint8_t* w_be, const uint8_t* sk_be,
                           uint8_t* out_val32) {
   // Row snapshot under the lock; decrypt + Horner OUTSIDE it (the
-  // by-value lagrange_cached pattern — see DkgRowCopy).
+  // snapshot-outside-the-lock pattern — see DkgRowCopy).
   DkgRowCopy rc;
   {
     DkgRegistry& reg = dkg_registry();
@@ -3859,6 +4591,8 @@ void* hbe_create(int32_t n, int32_t f) {
   for (int i = 0; i < n; ++i) e->nodes[i].id = i;
   const char* g = getenv("HBBFT_TPU_CT_HASH_CACHE");
   e->ct_hash_cache = !(g && g[0] == '0' && !g[1]);
+  const char* r = getenv("HBBFT_TPU_COIN_RLC");
+  e->rlc = !(r && r[0] == '0' && !r[1]);
   return e;
 }
 
@@ -3980,7 +4714,11 @@ uint64_t hbe_run_mt(void* h, uint64_t max_deliveries, int32_t n_threads) {
   Engine& e = *(Engine*)h;
   bool tampered = false;
   for (auto& nd : e.nodes) tampered = tampered || nd.tampered;
-  if (n_threads <= 1 || e.ext || e.pre_crank_cb || tampered)
+  // scalar_deferred: the deferred flush cadence is a sequential
+  // ordering, exactly like ext mode's (the Python layer also rejects
+  // threads > 1 with a scalar flush_every != 1).
+  if (n_threads <= 1 || e.ext || e.pre_crank_cb || tampered ||
+      scalar_deferred(e))
     return engine_run(e, max_deliveries);
   return engine_run_mt(e, max_deliveries, n_threads);
 }
@@ -4034,6 +4772,15 @@ void hbe_set_ext_crypto(void* h, int32_t flush_every, VerifyBatchCb verify_cb,
 
 void hbe_set_flush_every(void* h, int32_t flush_every) {
   ((Engine*)h)->flush_every = flush_every;
+}
+
+// Scalar RLC deferred verification on/off (round 7) — overrides the
+// HBBFT_TPU_COIN_RLC default read at hbe_create.  0 restores the
+// pre-round-7 path (submit-time verdicts, per-unit eager flush); with
+// 1, hbe_set_flush_every governs the scalar flush cadence (1 = the old
+// flush points exactly, 0 = queue-dry).
+void hbe_set_rlc(void* h, int32_t enabled) {
+  ((Engine*)h)->rlc = enabled != 0;
 }
 
 // -- adversarial scheduling -------------------------------------------------
@@ -4147,7 +4894,12 @@ uint64_t hbe_prof_count(void* h, int32_t type) {
 // Force a flush of all pending pools (top-level only).
 void hbe_flush(void* h) {
   Engine* e = (Engine*)h;
-  if (e->ext && e->pool_items > 0) engine_flush_ext(*e);
+  if (e->pool_items > 0) {
+    if (e->ext)
+      engine_flush_ext(*e);
+    else if (scalar_deferred(*e))
+      engine_flush_scalar(*e);
+  }
 }
 
 // Bytes-return helper for Sign/Combine callbacks: Python calls this with

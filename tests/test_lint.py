@@ -482,6 +482,24 @@ def test_engine_patched_free_slot_write_flagged(engine_src, monkeypatch):
     assert any("slot 12" in x.message for x in f)
 
 
+def test_engine_rlc_slot_claim_matches_stamps(engine_src, monkeypatch):
+    # Round 7 retired slot 11's settled round-4 continuation-max claim
+    # and re-claimed it for the scalar RLC verdict-pass stats.  Releasing
+    # the claim must flag scalar_rlc_verdicts' slot-11 stamps — pinning
+    # both directions: the RLC instrumentation really stamps the slot it
+    # claims, and the claim is not stale.
+    from tools.lint import cxxlints
+
+    monkeypatch.setattr(
+        cxxlints,
+        "CLAIMED_SLOTS",
+        {k: v for k, v in cxxlints.CLAIMED_SLOTS.items() if k != 11},
+    )
+    monkeypatch.setattr(cxxlints, "FREE_SLOTS", frozenset({11}))
+    f = [x for x in lint_source(engine_src) if x.rule == "HBC004"]
+    assert any("slot 11" in x.message for x in f)
+
+
 def test_engine_patched_unguarded_prof_write_flagged(engine_src):
     # A stamp added OUTSIDE the !mt_active guard (e.g. in pending_run,
     # which workers reach) must fail HBC002.

@@ -1,0 +1,346 @@
+"""Scalar RLC batch verification (round 7): byte-identity matrix.
+
+The native engine's deferred RLC path groups COIN/DECRYPT share checks
+per Ts/Td instance and verifies each group with one random-linear-
+combination check (``scalar_rlc_verdicts``), bisecting failed groups so
+every bad share is attributed exactly like the per-share path.  The
+invariant pinned here (docs/INVARIANTS.md "RLC byte-identity"):
+
+* ``flush_every=1`` keeps the pre-round-7 flush points, so RLC on/off
+  is byte-identical — batch sequences AND exact fault-log sequences.
+* ``flush_every=0`` (queue-dry deferral, maximal grouping) reorders
+  WORK, never results: batch sequences stay identical and fault logs
+  match as multisets (deferral can permute the order faults land in a
+  node's log, exactly like the ext-mode flush_every invariant).
+* Both hold under an adversary submitting corrupt coin and decryption
+  shares (the bisection path), with every fault pinned on a tampered
+  sender.
+"""
+
+import ctypes
+
+import pytest
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.net import NetBuilder
+from hbbft_tpu.net.adversary import TamperingAdversary
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.available(), reason="native engine unavailable"
+)
+
+SESSION = b"rlc-test"
+
+TS_INVALID = "threshold_sign:invalid-share"
+TD_INVALID = "threshold_decrypt:invalid-share"
+
+
+# Engine MsgType values for BA_COIN / HB_DECRYPT (native/engine.cpp).
+MT_COIN, MT_DECRYPT = 8, 10
+
+
+def noncanonical_node0_shares(nat):
+    """Node 0 re-encodes every outgoing share as ``value + r`` (still 32
+    bytes: r is ~254.9 bits) — CONGRUENT to the honest share but not
+    canonical.  The per-share TS check is representational equality and
+    faults it; the per-share TD check routes the share through mulmod
+    on both sides and accepts it.  The RLC group path must reproduce
+    exactly that asymmetry (a congruence-only group check would accept
+    the TS share and silently diverge the fault logs — the round-7
+    review's counterexample)."""
+    lib, h = nat.lib, nat.handle
+    mod = nat._suite.scalar_modulus
+
+    def on_tamper(sender, mtype, era, epoch, proposer, rnd):
+        if mtype not in (MT_COIN, MT_DECRYPT):
+            return
+        buf = (ctypes.c_uint8 * 32)()
+        lib.hbe_tamper_share(h, buf)
+        s = int.from_bytes(bytes(buf), "big")
+        out = (s + mod).to_bytes(32, "big")  # s < r and r < 2^255: fits
+        ob = (ctypes.c_uint8 * 32).from_buffer_copy(out)
+        lib.hbe_tamper_set_share(h, ob, 32)
+
+    nat._corrupt_cb = native_engine._TAMPER_CB(on_tamper)  # keep alive
+    lib.hbe_set_tamper(h, nat._corrupt_cb)
+    lib.hbe_set_tampered(h, 0, 1)
+
+
+def corrupt_node0_shares(nat):
+    """Make node 0 Byzantine in a content-deterministic way: a raw
+    engine tamper callback doubles every outgoing COIN/DECRYPT share of
+    node 0 and touches nothing else.
+
+    Why not the stock TamperingAdversary for the decrypt side: its
+    faulty nodes sort LAST in the FIFO delivery order, so their corrupt
+    decryption shares systematically arrive after f+1 honest shares
+    terminated the instance — dropped without ever reaching a verdict
+    (no fault, nothing for the RLC bisection to find).  Node 0 is FIRST
+    in every broadcast fan-out, so its corrupt shares reach verdicts
+    before termination.  And because the corruption depends only on the
+    message content (no rng, no schedule), runs at different flush
+    cadences see the SAME corruption — which is what makes the
+    RLC-on/off × flush_every matrix comparable under attack."""
+    lib, h = nat.lib, nat.handle
+    mod = nat._suite.scalar_modulus
+
+    def on_tamper(sender, mtype, era, epoch, proposer, rnd):
+        if mtype not in (MT_COIN, MT_DECRYPT):
+            return
+        buf = (ctypes.c_uint8 * 32)()
+        lib.hbe_tamper_share(h, buf)
+        s = int.from_bytes(bytes(buf), "big")
+        out = (2 * s % mod).to_bytes(32, "big")
+        ob = (ctypes.c_uint8 * 32).from_buffer_copy(out)
+        lib.hbe_tamper_set_share(h, ob, 32)
+
+    nat._corrupt_cb = native_engine._TAMPER_CB(on_tamper)  # keep alive
+    lib.hbe_set_tamper(h, nat._corrupt_cb)
+    lib.hbe_set_tampered(h, 0, 1)
+
+
+def run_native(n, seed, *, epochs=2, num_faulty=None, adversary=None,
+               corrupt_node0=False, noncanonical_node0=False, **kw):
+    nat = native_engine.NativeQhbNet(
+        n, seed=seed, batch_size=8, num_faulty=num_faulty,
+        session_id=SESSION, adversary=adversary, **kw,
+    )
+    if corrupt_node0:
+        corrupt_node0_shares(nat)
+    if noncanonical_node0:
+        noncanonical_node0_shares(nat)
+    for k in range(epochs):
+        for nid in nat.correct_ids:
+            nat.send_input(nid, Input.user(f"tx-{k}-{nid}"))
+    nat.run_until(
+        lambda e: all(
+            len(e.nodes[i].outputs) >= epochs for i in e.correct_ids
+        ),
+        chunk=5000,
+    )
+    out = {
+        "batches": [
+            [
+                (b.era, b.epoch, b.contributions, b.change, b.join_plan)
+                for b in nat.nodes[i].outputs
+            ]
+            for i in nat.correct_ids
+        ],
+        "faults": [nat.faults(i) for i in nat.correct_ids],
+        "prof": nat.prof_stats(),
+        "faulty_ids": list(nat.faulty_ids),
+    }
+    nat.close()
+    return out
+
+
+def test_rlc_on_off_byte_identical_at_flush_every_1():
+    """RLC on, flush_every=1: the grouped verdicts ride the exact
+    pre-round-7 flush points — everything byte-identical, fault ORDER
+    included."""
+    n, seed = 16, 7
+    old = run_native(n, seed, rlc=False)
+    new = run_native(n, seed, rlc=True, flush_every=1)
+    assert new["batches"] == old["batches"]
+    assert new["faults"] == old["faults"]
+
+
+def test_rlc_deferred_output_identical_at_flush_every_0():
+    """Queue-dry deferral (maximal grouping + folded group
+    continuations): identical batch sequences, fault multisets — and the
+    profile must prove grouping actually happened (a silently-eager RLC
+    path would pass the equality checks trivially)."""
+    n, seed = 16, 7
+    old = run_native(n, seed, rlc=False)
+    new = run_native(n, seed, rlc=True, flush_every=0)
+    assert new["batches"] == old["batches"]
+    assert [sorted(f) for f in new["faults"]] == [
+        sorted(f) for f in old["faults"]
+    ]
+    groups = new["prof"]["rlc_groups"]["count"]
+    shares = (
+        new["prof"]["COIN"]["count"] + new["prof"]["DECRYPT"]["count"]
+    )
+    assert groups > 0
+    # multi-share groups exist: strictly fewer groups than shares
+    assert groups < shares
+    assert old["prof"]["rlc_groups"]["count"] == 0
+
+
+def test_rlc_deferred_with_silent_faulty():
+    n, seed, f = 16, 11, 5
+    old = run_native(n, seed, num_faulty=f, rlc=False)
+    new = run_native(n, seed, num_faulty=f, rlc=True, flush_every=0)
+    assert new["batches"] == old["batches"]
+    assert [sorted(x) for x in new["faults"]] == [
+        sorted(x) for x in old["faults"]
+    ]
+
+
+@pytest.mark.parametrize("flush_every", [2, 7])
+def test_rlc_deferred_matches_python_net_cadence(flush_every):
+    """The scalar deferred cadence mirrors VirtualNet's flush_every
+    machinery (count per delivered message / top-level input, sorted
+    dirty-node rounds, queue-dry drain): at the same seed and cadence
+    the engine commits the same batch sequence as the pure-Python
+    stack.  Fault logs compare as multisets — the folded group
+    continuations may permute fault positions within one flush."""
+    n, seed = 6, 13
+    pynet = (
+        NetBuilder(n, seed=seed)
+        .num_faulty(1)
+        .max_cranks(10_000_000)
+        .flush_every(flush_every)
+        .protocol(
+            lambda ni, sink, rng: QueueingHoneyBadger(
+                ni, sink, batch_size=8, session_id=SESSION
+            )
+        )
+        .build()
+    )
+    nat = native_engine.NativeQhbNet(
+        n, seed=seed, batch_size=8, num_faulty=1, session_id=SESSION,
+        rlc=True, flush_every=flush_every,
+    )
+    for k in range(2):
+        for nid in nat.correct_ids:
+            pynet.send_input(nid, Input.user(f"tx-{k}-{nid}"))
+            nat.send_input(nid, Input.user(f"tx-{k}-{nid}"))
+
+    def py_batches(nid):
+        return [
+            o for o in pynet.node(nid).outputs if isinstance(o, DhbBatch)
+        ]
+
+    pynet.crank_until(
+        lambda net: all(
+            len(py_batches(i)) >= 2 for i in net.correct_ids
+        ),
+        max_cranks=10_000_000,
+    )
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 2 for i in e.correct_ids),
+        chunk=1,
+    )
+    for nid in pynet.correct_ids:
+        pyb = [
+            (b.era, b.epoch, b.contributions, b.change, b.join_plan)
+            for b in py_batches(nid)
+        ]
+        nab = [
+            (b.era, b.epoch, b.contributions, b.change, b.join_plan)
+            for b in nat.nodes[nid].outputs
+        ]
+        assert pyb == nab, f"node {nid} diverged"
+        pyf = sorted((fl.node_id, fl.kind) for fl in pynet.node(nid).faults)
+        naf = sorted(nat.faults(nid))
+        assert pyf == naf, f"node {nid} fault multisets diverged"
+    nat.close()
+
+
+def test_rlc_stock_tampering_adversary_byte_identical():
+    """The full stock TamperingAdversary rewrite set (flipped bvals,
+    corrupt proofs/roots, doubled shares) at flush_every=1: RLC on/off
+    must agree byte-for-byte — outputs AND exact fault logs.  (Its
+    corrupt DECRYPT shares systematically arrive post-termination on
+    the FIFO net and are dropped verdict-less; the corrupt-node0
+    harness below covers the decrypt bisection.)"""
+    n, seed = 16, 5
+    old = run_native(
+        n, seed, rlc=False, adversary=TamperingAdversary(tamper_p=1.0)
+    )
+    new = run_native(
+        n, seed, rlc=True, flush_every=1,
+        adversary=TamperingAdversary(tamper_p=1.0),
+    )
+    assert new["batches"] == old["batches"]
+    assert new["faults"] == old["faults"]
+    kinds = {k for flog in new["faults"] for (_, k) in flog}
+    assert TS_INVALID in kinds, "no corrupt coin share reached a verdict"
+
+
+def test_rlc_corrupt_shares_matrix():
+    """Corrupt coin AND decryption shares from node 0 (deterministic
+    content-only tampering — corrupt_node0_shares notes) across the
+    whole matrix: RLC off / on×flush_every=1 byte-identical (exact
+    fault order — pins the bisection's exact attribution), on×0
+    output-identical with matching fault multisets, every invalid-share
+    fault naming node 0, both fault kinds present, and failed groups
+    really flowing through the deferred grouping."""
+    n, seed = 16, 5
+    old = run_native(n, seed, rlc=False, corrupt_node0=True)
+    fe1 = run_native(n, seed, rlc=True, flush_every=1, corrupt_node0=True)
+    fe0 = run_native(n, seed, rlc=True, flush_every=0, corrupt_node0=True)
+    assert fe1["batches"] == old["batches"]
+    assert fe1["faults"] == old["faults"]
+    assert fe0["batches"] == old["batches"]
+    assert [sorted(f) for f in fe0["faults"]] == [
+        sorted(f) for f in old["faults"]
+    ]
+    for arm in (old, fe1, fe0):
+        kinds = {k for flog in arm["faults"] for (_, k) in flog}
+        assert TS_INVALID in kinds, "no corrupt coin share reached a verdict"
+        assert TD_INVALID in kinds, (
+            "no corrupt decryption share reached a verdict"
+        )
+        for flog in arm["faults"]:
+            for subj, kind in flog:
+                if kind in (TS_INVALID, TD_INVALID):
+                    assert subj == 0
+    assert fe0["prof"]["rlc_groups"]["count"] > 0
+    # determinism of the deferred adversarial run (the bisection path)
+    again = run_native(n, seed, rlc=True, flush_every=0, corrupt_node0=True)
+    assert again["batches"] == fe0["batches"]
+    assert again["faults"] == fe0["faults"]
+
+
+def test_rlc_noncanonical_share_encodings_match_per_share_path():
+    """Shares re-encoded as value+r (congruent, non-canonical): the
+    per-share TS check is representational and faults them, the
+    per-share TD check is congruence and accepts them — the RLC path
+    must mirror BOTH behaviors exactly across the matrix."""
+    n, seed = 16, 5
+    old = run_native(n, seed, rlc=False, noncanonical_node0=True)
+    fe1 = run_native(n, seed, rlc=True, flush_every=1,
+                     noncanonical_node0=True)
+    fe0 = run_native(n, seed, rlc=True, flush_every=0,
+                     noncanonical_node0=True)
+    assert fe1["batches"] == old["batches"]
+    assert fe1["faults"] == old["faults"]
+    assert fe0["batches"] == old["batches"]
+    assert [sorted(f) for f in fe0["faults"]] == [
+        sorted(f) for f in old["faults"]
+    ]
+    for arm in (old, fe1, fe0):
+        kinds = {k for flog in arm["faults"] for (_, k) in flog}
+        # TS: representational -> faulted in every arm.
+        assert TS_INVALID in kinds
+        # TD: congruence both paths -> never faulted in any arm.
+        assert TD_INVALID not in kinds
+
+
+def test_rlc_deferred_typed_profile_attribution():
+    """Deferred flushes run outside the typed delivery stamp; the engine
+    must fold verification + continuation cycles back into the
+    COIN/DECRYPT slots (otherwise the HBBFT_TPU_COIN_RLC A/B would
+    compare a number that silently excludes the RLC arm's own work)."""
+    out = run_native(16, 7, rlc=True, flush_every=0)
+    prof = out["prof"]
+    assert prof["COIN"]["count"] > 0
+    assert prof["COIN"]["cycles"] > 0
+    assert prof["DECRYPT"]["cycles"] > 0
+    assert prof["rlc_groups"]["cycles"] > 0
+
+
+def test_scalar_flush_every_requires_rlc():
+    with pytest.raises(ValueError):
+        native_engine.NativeQhbNet(4, seed=1, rlc=False, flush_every=0)
+
+
+def test_threads_reject_deferred_scalar_cadence():
+    with pytest.raises(ValueError):
+        native_engine.NativeQhbNet(
+            4, seed=1, rlc=True, flush_every=0, threads=2
+        )

@@ -93,6 +93,47 @@ while not era_done(nat) and rounds < 12:
     )
 assert era_done(nat), "sanitized era change did not complete"
 print("SANITIZED-ERA-OK")
+
+# Round 7: a deferred-RLC epoch with corrupt COIN/DECRYPT shares from
+# node 0 — every group containing one of its shares FAILS the RLC check
+# and runs the bisection (rlc_assign_range down to per-item leaves,
+# the CSR group scratch, the folded group continuations): the new
+# branchy code most likely to hide an OOB, exercised under the
+# sanitizer with verdicts ending in real fault entries.
+import ctypes
+from hbbft_tpu.native_engine import _TAMPER_CB
+
+nat2 = native_engine.NativeQhbNet(
+    4, seed=1, batch_size=3, session_id=b"sanitizer-rlc",
+    rlc=True, flush_every=0,
+)
+lib, h = nat2.lib, nat2.handle
+mod = nat2._suite.scalar_modulus
+
+def corrupt(sender, mtype, era, epoch, proposer, rnd):
+    if mtype not in (8, 10):  # BA_COIN / HB_DECRYPT
+        return
+    buf = (ctypes.c_uint8 * 32)()
+    lib.hbe_tamper_share(h, buf)
+    out = (2 * int.from_bytes(bytes(buf), "big") % mod).to_bytes(32, "big")
+    ob = (ctypes.c_uint8 * 32).from_buffer_copy(out)
+    lib.hbe_tamper_set_share(h, ob, 32)
+
+cb = _TAMPER_CB(corrupt)
+lib.hbe_set_tamper(h, cb)
+lib.hbe_set_tampered(h, 0, 1)
+# node 3 is silent-faulty (default f=1); nodes 1/2 are the honest
+# observers whose fault logs must pin node 0's corrupt shares.
+for i in nat2.correct_ids:
+    nat2.send_input(i, ("rlc-tx", i))
+nat2.run_until(
+    lambda e: all(len(e.nodes[i].outputs) >= 1 for i in (1, 2)),
+    chunk=256,
+)
+kinds = {k for i in (1, 2) for (_, k) in nat2.faults(i)}
+assert "threshold_sign:invalid-share" in kinds, kinds
+assert int(lib.hbe_prof_count(h, 11)) > 0, "RLC verdict pass never ran"
+print("SANITIZED-RLC-BISECT-OK")
 """
 
 
@@ -154,6 +195,7 @@ def test_asan_native_epoch():
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
+    assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "AddressSanitizer" not in res.stderr
 
 
@@ -163,6 +205,7 @@ def test_ubsan_native_epoch():
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
+    assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "runtime error" not in res.stderr
 
 
@@ -178,4 +221,5 @@ def test_tsan_multithread_epoch():
     assert res.returncode == 0, res.stderr[-4000:]
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
+    assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "WARNING: ThreadSanitizer" not in res.stderr

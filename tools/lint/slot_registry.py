@@ -11,8 +11,14 @@ longer appear (stale claims hide genuinely free slots).
 
 History: round 4 claimed 11/13/14; round-5 cleanup returned 12/15 to
 the free pool; round 6 claimed both for the era-change batch-tail
-split (batch_cb / contrib_cb wall — the before/after measurement for
-the native batch-digest fast path).
+split (batch_cb / contrib_cb wall).  Round 7 retired the two SETTLED
+round-4 diagnosis slots (11 = continuation max watermark, 13 = the
+>1M continuation tail — CLAUDE.md era-change envelope notes) and
+re-claimed them for the RLC work, since no slot was free
+(retire-and-reuse, never squat): 11 = scalar RLC group stats, 13 =
+the epoch-advance wall — which IS what the old tail heuristic was
+measuring, now stamped exactly and borrowed out of the typed
+per-message slots so COIN/DECRYPT cyc/delivery means share work.
 """
 
 # Dynamic range: prof_cycles[ty] / prof_count[ty], ty = MsgType 0..10.
@@ -20,9 +26,12 @@ TYPED_DELIVERY_SLOTS = frozenset(range(0, 11))
 
 # Literal-index claims: slot -> owner/purpose.
 CLAIMED_SLOTS = {
-    11: "continuation max cycles (engine_flush_pool tail split, round 4)",
+    11: "scalar RLC groups (cycles = group dispatch wall incl. chunked "
+        "checks, count = groups; engine_flush_pool/scalar_rlc_verdicts, "
+        "round 7)",
     12: "Python batch_cb wall cycles (commit_events, round 6 batch-digest A/B)",
-    13: "continuation tail >1M cycles (engine_flush_pool, round 4)",
+    13: "epoch-advance wall (hb_reset_state recycle + coin setup; "
+        "borrowed out of typed slots, round 7)",
     14: "pool-flush continuation total (engine_flush_pool, round 4)",
     15: "Python contrib_cb wall cycles (hb_accept_plaintext decode split, round 6)",
 }
