@@ -22,4 +22,10 @@ test-protocol:
 	$(PYTHON) -m pytest tests/ -q \
 		--ignore=tests/test_tpu_crypto.py --ignore=tests/test_jax_ops.py
 
-.PHONY: lint asan ubsan tsan test-protocol
+# N=4 TCP cluster smoke: 3 epochs over localhost sockets, kill/restart
+# and partition drills included (the ISSUE-4 acceptance surface).
+cluster-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport.py \
+		-q -m 'not slow'
+
+.PHONY: lint asan ubsan tsan test-protocol cluster-smoke

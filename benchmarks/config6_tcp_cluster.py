@@ -1,0 +1,100 @@
+"""Config 6: N-node TCP cluster epoch throughput over localhost.
+
+The first benchmark that pays real socket costs: serde encode/decode of
+every protocol message, frame plumbing, kernel round-trips, the ACK
+resume layer, and thread scheduling of 2N threads on this 1-core box —
+against the VirtualNet configs, the delta IS the transport tax.
+
+One JSON line per N (like config1..5):
+
+    BENCH_TCP_NS="4,8,16" BENCH_TCP_EPOCHS=5 python \
+        benchmarks/config6_tcp_cluster.py
+
+Env: BENCH_TCP_NS (comma list, default "4,8,16"), BENCH_TCP_EPOCHS
+(target epochs per N, default 5), BENCH_TCP_DEADLINE_S per N (default
+300), BENCH_TCP_METRICS=1 to embed the merged metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The cluster is jax-free (scalar suite, CPU protocol stack): the
+# import below must not drag the axon TPU plugin in, so keep the
+# environment as the caller set it (CLAUDE.md bypass applies if jax
+# ends up imported transitively).
+
+from hbbft_tpu.transport import LocalCluster  # noqa: E402
+
+
+def run_n(n: int, epochs: int, deadline_s: float) -> dict:
+    t0 = time.perf_counter()
+    cluster = LocalCluster(n, seed=0, batch_size=8)
+    setup_s = time.perf_counter() - t0
+    rec = {
+        "config": "config6_tcp_cluster",
+        "nodes": n,
+        "suite": "scalar",
+        "transport": "tcp-localhost",
+        "threads_per_node": 2,
+        "target_epochs": epochs,
+        "setup_s": round(setup_s, 3),
+    }
+    t0 = time.perf_counter()
+    try:
+        cluster.start()
+        try:
+            cluster.drive_to(range(n), epochs, timeout_s=deadline_s)
+        except TimeoutError:
+            pass  # report whatever committed within the deadline
+        wall = time.perf_counter() - t0
+        committed = min(len(cluster.batches(i)) for i in range(n))
+        m = cluster.merged_metrics()
+        frames = sum(
+            st["frames_out"]
+            for node in cluster.nodes.values()
+            for st in node.transport.stats().values()
+        )
+        wire_bytes = sum(
+            st["bytes_out"]
+            for node in cluster.nodes.values()
+            for st in node.transport.stats().values()
+        )
+        rec.update(
+            {
+                "epochs_committed": committed,
+                "wall_s": round(wall, 2),
+                "epochs_per_s": round(committed / wall, 3) if wall else None,
+                "msgs_handled": m.counters.get("cluster.msgs_handled", 0),
+                "msgs_per_s": round(
+                    m.counters.get("cluster.msgs_handled", 0) / wall, 1
+                ),
+                "frames_sent": frames,
+                "wire_mb": round(wire_bytes / 1e6, 2),
+                "protocol_faults": m.counters.get("cluster.protocol_faults", 0),
+                "handler_errors": m.counters.get("cluster.handler_errors", 0),
+                "complete": committed >= epochs,
+            }
+        )
+        if os.environ.get("BENCH_TCP_METRICS"):
+            rec["metrics"] = m.to_json()
+    finally:
+        cluster.stop()
+    return rec
+
+
+def main() -> None:
+    ns = [int(x) for x in os.environ.get("BENCH_TCP_NS", "4,8,16").split(",")]
+    epochs = int(os.environ.get("BENCH_TCP_EPOCHS", "5"))
+    deadline = float(os.environ.get("BENCH_TCP_DEADLINE_S", "300"))
+    for n in ns:
+        print(json.dumps(run_n(n, epochs, deadline)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,23 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
         if prof[name]["count"]
     }
     rec["rlc_groups"] = prof["rlc_groups"]
+    if os.environ.get("SCALE_METRICS"):
+        # Metrics-framework snapshot (counters/gauges; same shape the
+        # TCP transport exports) — SCALE_METRICS=prom dumps Prometheus
+        # text to stderr instead of embedding JSON.
+        from hbbft_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.gauge("scale.nodes", n)
+        m.count("scale.delivered", nat.delivered)
+        for name, s in prof.items():
+            if isinstance(s, dict) and "cycles" in s:
+                m.count(f"engine.cycles.{name}", s["cycles"])
+                m.count(f"engine.count.{name}", s["count"])
+        if os.environ.get("SCALE_METRICS") == "prom":
+            sys.stderr.write(m.prometheus_text())
+        else:
+            rec["metrics"] = m.to_json()
     nat.close()
     return rec
 

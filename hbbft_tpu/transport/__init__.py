@@ -1,0 +1,29 @@
+"""Real socket transport + cluster runtime (ISSUE 4).
+
+The first subsystem that runs hbbft nodes over actual TCP connections
+instead of the in-process simulator: length-prefixed serde frames
+(:mod:`.framing`), a selectors-based per-node event loop with
+backpressure and reconnect (:mod:`.transport`), a thread-per-node /
+subprocess cluster harness (:mod:`.cluster`), and a deterministic
+byte-level fault injector (:mod:`.faults`).  See docs/TRANSPORT.md.
+"""
+
+from hbbft_tpu.transport.cluster import ClusterNode, LocalCluster
+from hbbft_tpu.transport.faults import (
+    FaultInjector,
+    LinkFaults,
+    PartitionSpec,
+)
+from hbbft_tpu.transport.framing import (
+    KIND_HELLO,
+    KIND_MSG,
+    MAX_FRAME_LEN,
+    PROTO_VERSION,
+    RECV_CHUNK,
+    FrameDecoder,
+    FrameError,
+    decode_hello,
+    encode_frame,
+    encode_hello,
+)
+from hbbft_tpu.transport.transport import PeerStats, TcpTransport

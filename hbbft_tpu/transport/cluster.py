@@ -1,0 +1,434 @@
+"""Cluster runtime: N hbbft nodes talking over real localhost TCP.
+
+This is the first harness that takes the stack off the in-process
+simulator (:mod:`hbbft_tpu.net.virtual_net`): every node owns a
+:class:`~hbbft_tpu.transport.transport.TcpTransport` plus a protocol
+thread running the SenderQueue(QueueingHoneyBadger) stack, and the only
+way protocol state crosses nodes is serde-encoded frames on sockets.
+
+Per node, two threads:
+
+* the transport's selector loop (socket plane, owns all fds);
+* the protocol thread (consensus plane): drains an inbox of decoded-
+  frame events and local inputs, steps the protocol, serde-encodes each
+  outgoing :class:`TargetedMessage` once per payload and hands it to
+  the transport, then flushes the node's
+  :class:`~hbbft_tpu.crypto.pool.VerifyPool` through the configured
+  backend (eager ``flush_every=1`` semantics — reference-equivalent, the
+  deferred-batching invariant applies unchanged if a larger cadence is
+  ever wanted here).
+
+Keys are dealt exactly like :class:`~hbbft_tpu.net.virtual_net.
+NetBuilder` (same rng ritual at the same seed), so a TCP cluster at
+seed s agrees batch-for-batch with a VirtualNet run at seed s modulo
+scheduling; more importantly, a *subprocess* worker
+(:mod:`hbbft_tpu.transport.cluster_worker`) can derive its own keys
+from ``(seed, n, f)`` alone — no key material ever crosses a process
+boundary.
+
+Failure drills the tests lean on:
+
+* :meth:`LocalCluster.kill` / :meth:`LocalCluster.restart` — process
+  death: protocol state is discarded (fresh instance at era 0), the
+  listener port is reused so peers' backoff dials find the reborn node.
+* :meth:`LocalCluster.disconnect` / :meth:`LocalCluster.reconnect` —
+  network outage around a live process: sockets sever, protocol state
+  and both sides' outbound queues survive, and the sender-queue window
+  machinery replays/gates traffic on reconnect (churn test).
+
+Untrusted-input policy at this layer: a frame whose payload fails
+``serde.loads`` under the cluster's suite pin is counted
+(``cluster.bad_payload``) and dropped — framing-level violations
+already cost the sender its connection inside the transport.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from hbbft_tpu.crypto.backend import BatchedBackend, CryptoBackend
+from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
+from hbbft_tpu.crypto.pool import VerifyPool
+from hbbft_tpu.crypto.suite import ScalarSuite, Suite
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
+from hbbft_tpu.protocols.sender_queue import SenderQueue, SqMessage
+from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
+from hbbft_tpu.transport.transport import TcpTransport
+from hbbft_tpu.utils import serde
+from hbbft_tpu.utils.metrics import Metrics
+
+
+def deal_keys(
+    n: int, f: int, seed: int, suite: Suite
+) -> Tuple[SecretKeySet, Dict[int, SecretKey]]:
+    """NetBuilder's dealer ritual, factored out so every process of a
+    cluster derives identical keys from ``(n, f, seed)`` (rng draw
+    ORDER is part of the wire contract between processes — change it
+    only with a version bump in the cluster id)."""
+    rng = random.Random(seed)
+    sks = SecretKeySet.random(f, rng, suite)
+    node_sks = {i: SecretKey.random(rng, suite) for i in range(n)}
+    return sks, node_sks
+
+
+def build_netinfo(
+    n: int, f: int, seed: int, suite: Suite, our_id: int
+) -> NetworkInfo:
+    sks, node_sks = deal_keys(n, f, seed, suite)
+    val_ids = list(range(n))
+    node_pks = {i: node_sks[i].public_key() for i in val_ids}
+    return NetworkInfo(
+        our_id=our_id,
+        val_ids=val_ids,
+        public_key_set=sks.public_keys(),
+        secret_key_share=sks.secret_key_share(our_id),
+        public_keys=node_pks,
+        secret_key=node_sks[our_id],
+    )
+
+
+class ClusterNode:
+    """One node: protocol thread + transport, joined by an inbox."""
+
+    def __init__(
+        self,
+        node_id: int,
+        netinfo: NetworkInfo,
+        all_ids: List[int],
+        transport: TcpTransport,
+        backend: CryptoBackend,
+        suite: Suite,
+        seed: int,
+        protocol_factory: Callable[[NetworkInfo, Any, random.Random], ConsensusProtocol],
+        metrics: Optional[Metrics] = None,
+        inbox_cap: int = 50_000,
+    ) -> None:
+        self.id = node_id
+        self.netinfo = netinfo
+        self.all_ids = list(all_ids)
+        self.transport = transport
+        self.backend = backend
+        self.suite = suite
+        self.metrics = metrics if metrics is not None else transport.metrics
+        self.rng = random.Random((seed << 16) ^ (node_id + 1))
+        self.pool = VerifyPool()
+        self.protocol = protocol_factory(netinfo, self.pool, self.rng)
+        self.outputs: List[Any] = []
+        self.faults: List[Any] = []
+        # Bounded: a peer streaming faster than the protocol thread
+        # drains must hit receive-side backpressure (the transport drops
+        # its connection un-acked and it resumes later), not grow memory.
+        self.inbox: "queue.Queue[Tuple[str, Any, Any]]" = queue.Queue(
+            maxsize=inbox_cap
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._lock = threading.Lock()  # snapshot vs append on outputs
+        transport.on_message = self._on_frame_payload
+
+    # -- transport thread ----------------------------------------------
+    def _on_frame_payload(self, sender: Any, payload: bytes):
+        try:
+            self.inbox.put_nowait(("msg", sender, payload))
+        except queue.Full:
+            self.metrics.count("cluster.inbox_overflow")
+            return False  # transport: do not ack; drop the connection
+
+    # -- any thread ----------------------------------------------------
+    def submit(self, input: Any) -> None:
+        try:
+            self.inbox.put_nowait(("input", input, None))
+        except queue.Full:
+            # local inputs are droppable under overload (drivers pace);
+            # silently blocking the submitter could deadlock a test
+            self.metrics.count("cluster.input_dropped")
+
+    def batches(self) -> List[DhbBatch]:
+        with self._lock:
+            return [o for o in self.outputs if isinstance(o, DhbBatch)]
+
+    def start(self) -> None:
+        assert self._thread is None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"node-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop = True  # the flag, not a queue item: survives a full inbox
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    # -- protocol thread -----------------------------------------------
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                kind, a, b = self.inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                if kind == "msg":
+                    msg = serde.try_loads(b, suite=self.suite)
+                    # any well-formed-but-wrong-type payload is still
+                    # peer-authored garbage, not a local handler bug
+                    if msg is None or not isinstance(msg, SqMessage):
+                        self.metrics.count("cluster.bad_payload")
+                        continue
+                    self.metrics.count("cluster.msgs_handled")
+                    step = self.protocol.handle_message(a, msg, self.rng)
+                else:  # input
+                    step = self.protocol.handle_input(a, self.rng)
+                self._process_step(step)
+                while self.pool:
+                    self._process_step(self.pool.flush(self.backend))
+            except Exception:
+                # A handler bug must not take the thread down mid-run —
+                # count it loudly; tests assert this stays zero.
+                self.metrics.count("cluster.handler_errors")
+
+    def _process_step(self, step: Step) -> None:
+        if step.output:
+            with self._lock:
+                self.outputs.extend(step.output)
+        if step.fault_log.faults:
+            self.faults.extend(step.fault_log.faults)
+            self.metrics.count("cluster.protocol_faults", len(step.fault_log.faults))
+        for tm in step.messages:
+            data = serde.dumps(tm.message)
+            for dest in tm.target.recipients(self.all_ids, self.id):
+                self.transport.send(dest, data)
+
+
+def _default_protocol_factory(
+    batch_size: int, session_id: bytes, n: int
+) -> Callable[[NetworkInfo, Any, random.Random], ConsensusProtocol]:
+    def factory(ni: NetworkInfo, sink: Any, rng: random.Random) -> ConsensusProtocol:
+        return SenderQueue.wrap(
+            lambda s: QueueingHoneyBadger(
+                ni, s, batch_size=batch_size, session_id=session_id
+            ),
+            sink,
+            peers=list(range(n)),
+        )
+
+    return factory
+
+
+class LocalCluster:
+    """N thread-per-node TCP nodes on localhost.
+
+    ``injector`` (a :class:`~hbbft_tpu.transport.faults.FaultInjector`)
+    is shared by every node's transport, so one schedule partitions /
+    degrades the whole cluster deterministically.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        seed: int = 0,
+        batch_size: int = 8,
+        num_faulty: Optional[int] = None,
+        session_id: bytes = b"tcp-cluster",
+        cluster_id: bytes = b"hbbft-tpu/cluster/v1",
+        suite: Optional[Suite] = None,
+        backend_factory: Callable[[Suite], CryptoBackend] = BatchedBackend,
+        protocol_factory: Optional[
+            Callable[[NetworkInfo, Any, random.Random], ConsensusProtocol]
+        ] = None,
+        injector: Any = None,
+        max_frame_len: Optional[int] = None,
+        max_queue_frames: int = 20_000,
+    ) -> None:
+        self.n = n
+        self.seed = seed
+        self.f = num_faulty if num_faulty is not None else (n - 1) // 3
+        assert 3 * self.f < n, f"need 3f < N (got N={n}, f={self.f})"
+        self.suite = suite if suite is not None else ScalarSuite()
+        self.cluster_id = cluster_id
+        self.injector = injector
+        self.metrics = Metrics()
+        factory = protocol_factory or _default_protocol_factory(
+            batch_size, session_id, n
+        )
+        self._factory = factory
+        self._backend_factory = backend_factory
+        self._transport_kwargs: Dict[str, Any] = dict(
+            max_queue_frames=max_queue_frames,
+        )
+        if max_frame_len is not None:
+            self._transport_kwargs["max_frame_len"] = max_frame_len
+
+        # Bind every listener first so the full address map exists
+        # before any node is constructed.
+        self.nodes: Dict[int, ClusterNode] = {}
+        transports: Dict[int, TcpTransport] = {}
+        for i in range(n):
+            transports[i] = TcpTransport(
+                node_id=i,
+                cluster_id=cluster_id,
+                metrics=Metrics(),
+                injector=injector,
+                seed=seed,
+                **self._transport_kwargs,
+            )
+        self.addr_map: Dict[int, Tuple[str, int]] = {
+            i: t.addr for i, t in transports.items()
+        }
+        for i, t in transports.items():
+            t.set_peers({j: a for j, a in self.addr_map.items() if j != i})
+            self.nodes[i] = ClusterNode(
+                node_id=i,
+                netinfo=build_netinfo(n, self.f, seed, self.suite, i),
+                all_ids=list(range(n)),
+                transport=t,
+                backend=backend_factory(self.suite),
+                suite=self.suite,
+                seed=seed,
+                protocol_factory=factory,
+            )
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self.injector is not None:
+            self.injector.start()
+        for node in self.nodes.values():
+            node.transport.start()
+            node.start()
+        self._started = True
+
+    def stop(self) -> None:
+        for node in self.nodes.values():
+            node.stop()
+            node.transport.stop()
+        self._started = False
+
+    def __enter__(self) -> "LocalCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- failure drills ------------------------------------------------
+    def kill(self, node_id: int) -> None:
+        """Process death: the node's threads stop, its sockets reset,
+        its protocol state is GONE (restart() builds a fresh instance)."""
+        node = self.nodes[node_id]
+        node.stop()
+        node.transport.stop()
+
+    def restart(self, node_id: int) -> None:
+        """Re-create the killed node on its old port with fresh state."""
+        old = self.nodes[node_id]
+        port = old.transport.port
+        t = TcpTransport(
+            node_id=node_id,
+            cluster_id=self.cluster_id,
+            peers={j: a for j, a in self.addr_map.items() if j != node_id},
+            metrics=Metrics(),
+            injector=self.injector,
+            seed=self.seed,
+            port=port,
+            **self._transport_kwargs,
+        )
+        node = ClusterNode(
+            node_id=node_id,
+            netinfo=build_netinfo(self.n, self.f, self.seed, self.suite, node_id),
+            all_ids=list(range(self.n)),
+            transport=t,
+            backend=self._backend_factory(self.suite),
+            suite=self.suite,
+            seed=self.seed,
+            protocol_factory=self._factory,
+        )
+        self.nodes[node_id] = node
+        if self._started:
+            t.start()
+            node.start()
+
+    def disconnect(self, node_id: int) -> None:
+        """Network outage around a live process (state survives)."""
+        self.nodes[node_id].transport.set_offline(True)
+
+    def reconnect(self, node_id: int) -> None:
+        self.nodes[node_id].transport.set_offline(False)
+
+    # -- driving -------------------------------------------------------
+    def submit(self, node_id: int, input: Any) -> None:
+        self.nodes[node_id].submit(input)
+
+    def submit_all(self, input_fn: Callable[[int], Any]) -> None:
+        for i in sorted(self.nodes):
+            self.submit(i, input_fn(i))
+
+    def batches(self, node_id: int) -> List[DhbBatch]:
+        return self.nodes[node_id].batches()
+
+    def wait(
+        self,
+        pred: Callable[["LocalCluster"], bool],
+        timeout_s: float,
+        poll_s: float = 0.02,
+    ) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if pred(self):
+                return True
+            time.sleep(poll_s)
+        return pred(self)
+
+    def drive_to(
+        self,
+        ids: Sequence[int],
+        target: int,
+        timeout_s: float = 60.0,
+        tag: str = "d",
+    ) -> None:
+        """Feed txns to every live node until every node in ``ids`` has
+        committed >= ``target`` batches; raises on timeout.
+
+        Submission is PACED against committed epochs (at most ~2 rounds
+        of txns ahead of the slowest observed node): an unpaced feeder
+        builds a transaction backlog that keeps committing epochs long
+        after the target — the CLAUDE.md pacing invariant, held here
+        ONCE for tests, benchmarks, and examples.
+        """
+        deadline = time.monotonic() + timeout_s
+        base = min(len(self.batches(i)) for i in ids)
+        k = 0
+        while time.monotonic() < deadline:
+            mn = min(len(self.batches(i)) for i in ids)
+            if mn >= target:
+                return
+            if k < (mn - base) + 3:
+                for i in sorted(self.nodes):
+                    if self.nodes[i]._thread is not None:
+                        self.submit(i, Input.user(f"{tag}-{k}-{i}"))
+                k += 1
+            time.sleep(0.05)
+        counts = {i: len(self.batches(i)) for i in sorted(self.nodes)}
+        raise TimeoutError(
+            f"no progress to {target} batches within {timeout_s}s: {counts}"
+        )
+
+    # -- observability -------------------------------------------------
+    def merged_metrics(self) -> Metrics:
+        m = Metrics()
+        for node in self.nodes.values():
+            node.transport.export_metrics()
+            m.merge(node.metrics)
+        m.merge(self.metrics)
+        return m
+
+    def transport_stats(self) -> Dict[int, Dict[Any, Dict[str, int]]]:
+        return {i: node.transport.stats() for i, node in self.nodes.items()}

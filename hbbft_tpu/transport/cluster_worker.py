@@ -1,0 +1,131 @@
+"""Subprocess cluster worker: one hbbft node per OS process.
+
+``python -m hbbft_tpu.transport.cluster_worker --node-id I --n N
+--seed S --port P --peers host:port,host:port,... --epochs E`` runs one
+node of a TCP cluster to ``E`` committed epochs and prints one JSON
+line per committed batch (``{"era":..,"epoch":..,"contributions":..}``)
+followed by a final ``{"done": true, ...}`` summary — the parent (a
+``slow``-marked test, or a human) compares the batch lines across
+workers for byte-identical commits.
+
+Key material is DERIVED, not transported: every worker replays the
+dealer ritual (:func:`~hbbft_tpu.transport.cluster.deal_keys`) from
+``(n, f, seed)``, so nothing secret crosses the process boundary.
+Inputs are self-submitted (``tx-<node>-<k>`` whenever the committed
+count grows), which keeps the worker driver-free.
+
+This is the flag-gated subprocess mode of ISSUE 4; the thread-per-node
+:class:`~hbbft_tpu.transport.cluster.LocalCluster` is the default on
+this 1-core box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+from hbbft_tpu.transport.cluster import ClusterNode, build_netinfo
+from hbbft_tpu.transport.cluster import _default_protocol_factory
+from hbbft_tpu.crypto.backend import BatchedBackend
+from hbbft_tpu.crypto.suite import ScalarSuite
+from hbbft_tpu.transport.transport import TcpTransport
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node-id", type=int, required=True)
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--num-faulty", type=int, default=-1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--peers",
+        required=True,
+        help="comma list host:port indexed by node id (our own slot included)",
+    )
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--session-id", default="tcp-cluster")
+    ap.add_argument("--cluster-id", default="hbbft-tpu/cluster/v1")
+    args = ap.parse_args(argv)
+
+    n = args.n
+    f = args.num_faulty if args.num_faulty >= 0 else (n - 1) // 3
+    suite = ScalarSuite()
+    addrs = []
+    for slot in args.peers.split(","):
+        host, _, port = slot.rpartition(":")
+        addrs.append((host, int(port)))
+    assert len(addrs) == n, "--peers must list every node"
+
+    transport = TcpTransport(
+        node_id=args.node_id,
+        cluster_id=args.cluster_id.encode(),
+        peers={j: addrs[j] for j in range(n) if j != args.node_id},
+        port=args.port,
+        seed=args.seed,
+    )
+    node = ClusterNode(
+        node_id=args.node_id,
+        netinfo=build_netinfo(n, f, args.seed, suite, args.node_id),
+        all_ids=list(range(n)),
+        transport=transport,
+        backend=BatchedBackend(suite),
+        suite=suite,
+        seed=args.seed,
+        protocol_factory=_default_protocol_factory(
+            args.batch_size, args.session_id.encode(), n
+        ),
+    )
+    transport.start()
+    node.start()
+
+    reported = 0
+    submitted = 0
+    deadline = time.monotonic() + args.timeout_s
+    try:
+        while reported < args.epochs and time.monotonic() < deadline:
+            batches = node.batches()
+            if submitted <= len(batches):
+                node.submit(Input.user(f"tx-{args.node_id}-{submitted}"))
+                submitted += 1
+            for b in batches[reported:]:
+                print(
+                    json.dumps(
+                        {
+                            "era": b.era,
+                            "epoch": b.epoch,
+                            "contributions": [
+                                [p, list(c)] for p, c in b.contributions
+                            ],
+                        },
+                        sort_keys=True,
+                    ),
+                    flush=True,
+                )
+                reported += 1
+            time.sleep(0.02)
+        print(
+            json.dumps(
+                {
+                    "done": reported >= args.epochs,
+                    "node": args.node_id,
+                    "batches": reported,
+                    "faults": len(node.faults),
+                },
+                sort_keys=True,
+            ),
+            flush=True,
+        )
+        return 0 if reported >= args.epochs else 1
+    finally:
+        node.stop()
+        transport.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

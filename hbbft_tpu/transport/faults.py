@@ -1,0 +1,210 @@
+"""Byte-level fault injection at the transport send path.
+
+:mod:`hbbft_tpu.net.adversary` owns *scheduling* adversaries inside the
+in-process simulator; this module mirrors those semantics one layer
+down, on the encoded frames a real node writes to real sockets:
+
+* **drop** — the frame never leaves the sender;
+* **duplicate** — the frame is queued twice;
+* **delay / reorder** — the frame is held for a bounded time before
+  queueing, so later frames overtake it (per-link frame order is the
+  only order TCP gives us; delaying is how reordering manifests at this
+  layer);
+* **corrupt** — bit-flips in the encoded bytes.  Downstream, the frame
+  decoder / serde boundary must reject these by dropping the connection
+  — never by crashing (tests/test_transport.py);
+* **partition / heal** — a schedule of time windows during which links
+  between node groups drop every frame; outside the windows the links
+  are clean.
+
+Determinism: decisions are drawn from a per-*link* ``random.Random``
+seeded by ``(seed, src, dst)``, so the k-th frame on a given link gets
+the same verdict on every run regardless of thread interleaving across
+links.  Partition windows are wall-clock offsets from ``start()`` —
+coarse enough (seconds) that scheduling jitter does not move a frame
+across a window edge in practice; tests drive the windows explicitly.
+
+One injector instance is shared by all nodes of an in-process cluster
+(:class:`~hbbft_tpu.transport.cluster.LocalCluster` passes it to every
+transport); its per-link state needs no lock beyond the GIL because
+each ``(src, dst)`` link is only ever touched by src's transport
+thread.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class PartitionSpec:
+    """Nodes split into ``groups`` from ``start_s`` until ``heal_s``
+    (offsets in seconds from injector start; ``heal_s=None`` = never
+    heals).  Frames between different groups are dropped; frames inside
+    one group pass.  A node in no group is unrestricted."""
+
+    groups: Tuple[FrozenSet, ...]
+    start_s: float = 0.0
+    heal_s: Optional[float] = None
+
+    def blocks(self, src, dst, t: float) -> bool:
+        if t < self.start_s or (self.heal_s is not None and t >= self.heal_s):
+            return False
+        sg = dg = None
+        for i, g in enumerate(self.groups):
+            if src in g:
+                sg = i
+            if dst in g:
+                dg = i
+        return sg is not None and dg is not None and sg != dg
+
+
+@dataclass
+class LinkFaults:
+    """Per-link fault probabilities (applied frame-by-frame, in order)."""
+
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    delay_p: float = 0.0
+    delay_s: Tuple[float, float] = (0.01, 0.05)  # uniform range when delayed
+    corrupt_p: float = 0.0
+    max_flips: int = 3  # bit flips per corrupted frame (>= 1)
+
+
+@dataclass
+class FaultStats:
+    """Cross-link totals.  Unlike the per-link rngs (single-writer by
+    construction), these are incremented from every node's transport
+    thread — the lock keeps the read-modify-writes from losing counts."""
+
+    dropped: int = 0
+    duplicated: int = 0
+    delayed: int = 0
+    corrupted: int = 0
+    partitioned: int = 0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+
+class FaultInjector:
+    """Deterministic-by-seed frame mangler for the TCP transport.
+
+    ``on_send(src, dst, data) -> [(extra_delay_s, bytes), ...]`` is the
+    whole interface the transport uses: an empty list means the frame
+    was dropped; multiple entries mean duplication; a nonzero delay
+    means the transport holds that copy on its timer heap before
+    queueing it.  Without an injector the transport sends
+    ``[(0.0, data)]`` — the injector is pure policy, never plumbing.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        default: Optional[LinkFaults] = None,
+        links: Optional[Dict[Tuple, LinkFaults]] = None,
+        partitions: Optional[List[PartitionSpec]] = None,
+    ) -> None:
+        self.seed = seed
+        self.default = default or LinkFaults()
+        self.links = dict(links or {})
+        self.partitions = list(partitions or [])
+        self.stats = FaultStats()
+        self._rngs: Dict[Tuple, random.Random] = {}
+        self._t0: Optional[float] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, now: Optional[float] = None) -> None:
+        """Anchor partition-window offsets; called by the cluster when
+        the transports come up (idempotent: first call wins, so every
+        node shares one clock origin)."""
+        if self._t0 is None:
+            self._t0 = time.monotonic() if now is None else now
+
+    def elapsed(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return time.monotonic() - self._t0
+
+    # -- dynamic schedule edits (tests drive heal explicitly) ----------
+    def add_partition(self, spec: PartitionSpec) -> None:
+        self.partitions.append(spec)
+
+    def heal_all(self) -> None:
+        """End every ACTIVE partition now (explicit heal, no clock).
+        Windows scheduled to start in the future are left untouched."""
+        t = self.elapsed()
+        self.partitions = [
+            p
+            if p.start_s > t
+            else PartitionSpec(
+                p.groups,
+                p.start_s,
+                min(p.heal_s, t) if p.heal_s is not None else t,
+            )
+            for p in self.partitions
+        ]
+
+    # -- the send hook -------------------------------------------------
+    def _rng(self, src, dst) -> random.Random:
+        key = (src, dst)
+        rng = self._rngs.get(key)
+        if rng is None:
+            rng = self._rngs[key] = random.Random(f"{self.seed}|{src}|{dst}")
+        return rng
+
+    def on_send(self, src, dst, data: bytes) -> List[Tuple[float, bytes]]:
+        t = self.elapsed()
+        for p in self.partitions:
+            if p.blocks(src, dst, t):
+                self.stats.bump('partitioned')
+                return []
+        lf = self.links.get((src, dst), self.default)
+        rng = self._rng(src, dst)
+        # Draw every decision unconditionally so the per-link sequence
+        # of verdicts is a pure function of (seed, src, dst, frame
+        # index) — independent of which faults are enabled elsewhere.
+        r_drop = rng.random()
+        r_dup = rng.random()
+        r_delay = rng.random()
+        u_delay = rng.random()
+        r_corrupt = rng.random()
+        if lf.drop_p and r_drop < lf.drop_p:
+            self.stats.bump('dropped')
+            return []
+        if lf.corrupt_p and r_corrupt < lf.corrupt_p:
+            # flip positions come from a rng DERIVED from this frame's
+            # unconditional corrupt draw, not from the verdict stream —
+            # otherwise enabling corruption would shift every later
+            # frame's drop/dup/delay verdicts on the link
+            data = self._corrupt(data, random.Random(r_corrupt), lf.max_flips)
+            self.stats.bump('corrupted')
+        delay = 0.0
+        if lf.delay_p and r_delay < lf.delay_p:
+            lo, hi = lf.delay_s
+            delay = lo + (hi - lo) * u_delay
+            self.stats.bump('delayed')
+        out = [(delay, data)]
+        if lf.dup_p and r_dup < lf.dup_p:
+            self.stats.bump('duplicated')
+            out.append((delay, data))
+        return out
+
+    @staticmethod
+    def _corrupt(data: bytes, rng: random.Random, max_flips: int) -> bytes:
+        buf = bytearray(data)
+        # max(1, ...) twice: a corrupted frame always flips >= 1 bit,
+        # and a user-supplied max_flips of 0 must not raise from inside
+        # the sender's protocol thread
+        for _ in range(rng.randrange(max(1, max_flips)) + 1):
+            i = rng.randrange(len(buf))
+            buf[i] ^= 1 << rng.randrange(8)
+        return bytes(buf)

@@ -1,0 +1,211 @@
+"""Length-prefixed frames over the serde codec: the TCP trust boundary.
+
+A peer socket delivers an untrusted byte stream.  This module slices it
+into bounded frames before any of those bytes reach object construction:
+
+    frame   := len:u32  crc:u32  kind:u8  payload[len-1]
+
+``len`` counts the kind byte plus the payload and is hard-capped by
+``max_frame_len`` — a declared length past the cap is rejected from the
+4-byte prefix alone, so a malicious peer can never make a node buffer
+(let alone parse) an unbounded message.  The cap is deliberately far
+below serde's own 256 MiB per-field bound (``serde._MAX_LEN``, enforced
+byte-identically by the C token scanner ``hbe_serde_scan``): serde
+bounds any *one* length field, the frame cap bounds the *whole* message
+— both limits apply on the read path, framing first.
+
+``crc`` is CRC32 over ``kind || payload``.  It is NOT an integrity MAC
+(a Byzantine peer computes valid CRCs for garbage); it pins down
+*channel* corruption — without it, a bit flip inside the payload could
+still frame-parse (or, worse, a flipped length prefix could re-frame
+the remainder into bogus frames that get consumed and ACKed, desyncing
+the resume layer's cumulative count and silently discarding a clean
+frame).  With the CRC, any flipped transmission dies at the framing
+layer: connection dropped un-ACKed, and the resume layer retransmits
+the CLEAN original — which is exactly the channel-fault model
+:mod:`hbbft_tpu.transport.faults` injects.
+
+Frame kinds:
+
+* ``KIND_HELLO`` — connection handshake.  Payload is the serde encoding
+  of ``(PROTO_VERSION, cluster_id, node_id)``; the acceptor learns who
+  is talking and rejects version/cluster mismatches (a node from a
+  different cluster config speaks a disjoint session id, so its
+  protocol messages must never reach a handler).
+* ``KIND_MSG`` — one protocol message; payload is the serde encoding of
+  an :class:`~hbbft_tpu.protocols.sender_queue.SqMessage` tree, decoded
+  with the cluster's suite pin.
+* ``KIND_ACK`` — cumulative delivery acknowledgement, payload a fixed
+  8-byte big-endian count of MSG frames the acceptor has consumed on
+  this link *ever* (across reconnects).  Flows acceptor -> dialer on
+  the otherwise-unused reverse direction of a connection; the dialer
+  retains unacked frames and retransmits them after a reconnect, which
+  is what makes a mid-epoch disconnect lossless for a surviving process
+  (transport.py "resume layer").
+
+Decode errors raise :class:`FrameError`; the transport's uniform
+response is: count the fault in metrics, drop the connection (the
+stream is unsynchronized garbage from that point), and let reconnect
+establish a fresh one (tests/test_transport.py pins this never crashes
+a node).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, List, Optional, Tuple
+
+from hbbft_tpu.utils import serde
+
+#: Default whole-frame cap (16 MiB).  An N=1024 DKG-era contribution is
+#: ~1 MB; nothing the current stack emits approaches this.  Configurable
+#: per transport, but every read path MUST enforce *some* cap (lint rule
+#: HBT006 pins the recv plumbing).
+MAX_FRAME_LEN = 1 << 24
+
+#: Bounded socket read size.  recv() callers use this constant so a
+#: single syscall can never hand us more than 64 KiB to buffer before
+#: the frame-length check applies (HBT006).
+RECV_CHUNK = 1 << 16
+
+PROTO_VERSION = 1
+
+KIND_HELLO = 0x01
+KIND_MSG = 0x02
+KIND_ACK = 0x03
+
+_KINDS = (KIND_HELLO, KIND_MSG, KIND_ACK)
+
+
+def encode_ack(count: int) -> bytes:
+    """Cumulative-consumed ACK frame (fixed 17 bytes on the wire)."""
+    return encode_frame(KIND_ACK, count.to_bytes(8, "big"))
+
+
+def decode_ack(payload: bytes) -> int:
+    if len(payload) != 8:
+        raise FrameError("ACK payload must be 8 bytes")
+    return int.from_bytes(payload, "big")
+
+_LEN_BYTES = 4
+_CRC_BYTES = 4
+_HDR_BYTES = _LEN_BYTES + _CRC_BYTES
+
+
+class FrameError(ValueError):
+    """Malformed, oversized, corrupted, or version-mismatched frame."""
+
+
+def encode_frame(kind: int, payload: bytes, max_frame_len: int = MAX_FRAME_LEN) -> bytes:
+    """One wire frame.  Raises :class:`FrameError` if the frame would
+    exceed ``max_frame_len`` (the local cap: never emit what a peer
+    honoring the same limits would have to reject)."""
+    if kind not in _KINDS:
+        raise FrameError(f"unknown frame kind 0x{kind:02x}")
+    length = 1 + len(payload)
+    if length > max_frame_len:
+        raise FrameError(
+            f"frame of {length} bytes exceeds max_frame_len={max_frame_len}"
+        )
+    body = bytes([kind]) + payload
+    return (
+        length.to_bytes(_LEN_BYTES, "big")
+        + zlib.crc32(body).to_bytes(_CRC_BYTES, "big")
+        + body
+    )
+
+
+class FrameDecoder:
+    """Incremental frame slicer over an untrusted byte stream.
+
+    ``feed(data)`` buffers; ``next_frame()`` returns ``(kind, payload)``
+    or ``None`` when the buffer holds no complete frame.  Any violation
+    raises :class:`FrameError` and poisons the decoder (the stream has
+    no recoverable sync point) — callers drop the connection.
+    """
+
+    __slots__ = ("max_frame_len", "_buf", "_poisoned")
+
+    def __init__(self, max_frame_len: int = MAX_FRAME_LEN) -> None:
+        self.max_frame_len = max_frame_len
+        self._buf = bytearray()
+        self._poisoned = False
+
+    def feed(self, data: bytes) -> None:
+        if self._poisoned:
+            raise FrameError("decoder poisoned by an earlier frame error")
+        self._buf += data
+
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def next_frame(self) -> Optional[Tuple[int, bytes]]:
+        if self._poisoned:
+            raise FrameError("decoder poisoned by an earlier frame error")
+        buf = self._buf
+        if len(buf) < _LEN_BYTES:
+            return None
+        length = int.from_bytes(buf[:_LEN_BYTES], "big")
+        if length < 1 or length > self.max_frame_len:
+            self._poisoned = True
+            raise FrameError(
+                f"declared frame length {length} outside [1, {self.max_frame_len}]"
+            )
+        if len(buf) < _HDR_BYTES + length:
+            return None
+        crc = int.from_bytes(buf[_LEN_BYTES:_HDR_BYTES], "big")
+        body = bytes(buf[_HDR_BYTES : _HDR_BYTES + length])
+        if zlib.crc32(body) != crc:
+            self._poisoned = True
+            raise FrameError("frame CRC mismatch (channel corruption)")
+        kind = body[0]
+        if kind not in _KINDS:
+            self._poisoned = True
+            raise FrameError(f"unknown frame kind 0x{kind:02x}")
+        del buf[: _HDR_BYTES + length]
+        return kind, body[1:]
+
+    def frames(self) -> List[Tuple[int, bytes]]:
+        out = []
+        while True:
+            f = self.next_frame()
+            if f is None:
+                return out
+            out.append(f)
+
+
+# -- handshake ---------------------------------------------------------------
+
+
+def encode_hello(
+    node_id: Any, cluster_id: bytes, max_frame_len: int = MAX_FRAME_LEN
+) -> bytes:
+    return encode_frame(
+        KIND_HELLO,
+        serde.dumps((PROTO_VERSION, cluster_id, node_id)),
+        max_frame_len,
+    )
+
+
+def decode_hello(payload: bytes, cluster_id: bytes) -> Any:
+    """Validate a HELLO payload; returns the announced node id.
+
+    Raises :class:`FrameError` on malformed serde, version mismatch, or
+    foreign cluster id (never a crash: this is peer-authored input).
+    """
+    obj = serde.try_loads(payload)
+    if (
+        not isinstance(obj, tuple)
+        or len(obj) != 3
+        or type(obj[0]) is not int
+        or type(obj[1]) is not bytes
+    ):
+        raise FrameError("malformed HELLO")
+    version, cid, node_id = obj
+    if version != PROTO_VERSION:
+        raise FrameError(f"protocol version {version} != {PROTO_VERSION}")
+    if cid != cluster_id:
+        raise FrameError("HELLO from a different cluster")
+    if type(node_id) not in (int, str, bytes):
+        raise FrameError("bad node id in HELLO")
+    return node_id

@@ -1,0 +1,794 @@
+"""Non-blocking TCP transport: one node's socket plane.
+
+Design (ISSUE 4 tentpole; patterned after thetacrypt's networked
+threshold-service deployments, PAPERS.md):
+
+* One ``selectors``-based event loop per node, running on its own
+  thread.  All socket state is owned by that thread; other threads talk
+  to it through a control deque + self-pipe wakeup (``send``,
+  ``set_offline``, ``stop``).
+* **Connection topology:** each node *dials* every peer it sends to and
+  *accepts* from every peer that sends to it — two unidirectional TCP
+  connections per talking pair.  The dialer writes frames; the acceptor
+  only reads.  This removes the simultaneous-connect dedupe dance
+  entirely (both sides dialing each other is the normal state, not a
+  conflict).
+* **Handshake:** the dialer's first frame is ``HELLO(version,
+  cluster_id, node_id)``; the acceptor learns the sender's identity
+  from it and drops version/cluster mismatches.  Protocol frames on a
+  connection before its HELLO are a protocol violation (dropped
+  connection).
+* **Outbound queues + backpressure:** per-peer FIFO of encoded frames,
+  capped in frames and bytes (``max_queue_frames`` /
+  ``max_queue_bytes``).  Overflow drops the NEWEST frame and counts it
+  (``queue_overflow``) — HoneyBadger tolerates message loss to f nodes,
+  and the sender queue re-gates per-epoch traffic, so bounded loss
+  under backpressure is protocol-safe; unbounded buffering toward a
+  dead peer is not memory-safe.  Queues survive disconnects: frames not
+  yet written when a connection dies are re-sent on the next connect
+  (bytes already in the kernel buffer of a dead peer are gone — that is
+  the loss window a mid-epoch crash produces).
+* **Reconnect:** failed dials retry with exponential backoff + jitter
+  (``backoff_base_s * 2^attempts`` capped at ``backoff_cap_s``, times
+  ``1 + jitter * u``), seeded per node for reproducible tests.
+* **Fault injection:** an optional
+  :class:`~hbbft_tpu.transport.faults.FaultInjector` sits exactly at
+  the send boundary (encoded frame -> list of delayed/mangled copies).
+* **Observability:** per-peer :class:`PeerStats` (bytes/frames in+out,
+  queue depth, drops, reconnects, frame errors) exported into
+  :class:`~hbbft_tpu.utils.metrics.Metrics` as counters + gauges.
+
+Read-path safety: every ``recv`` is bounded by ``RECV_CHUNK`` and every
+received byte goes through a :class:`FrameDecoder` capped at
+``max_frame_len`` *before* any parsing (lint rule HBT006 machine-checks
+both).  A frame error never crashes the node: the connection is
+dropped, the fault is counted, and reconnect recovers.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import itertools
+import random
+import selectors
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from hbbft_tpu.transport.framing import (
+    KIND_ACK,
+    KIND_HELLO,
+    KIND_MSG,
+    MAX_FRAME_LEN,
+    RECV_CHUNK,
+    FrameDecoder,
+    FrameError,
+    decode_ack,
+    decode_hello,
+    encode_ack,
+    encode_frame,
+    encode_hello,
+)
+from hbbft_tpu.utils.metrics import Metrics
+
+
+@dataclass
+class PeerStats:
+    """One peer's transport counters (single-writer: the loop thread)."""
+
+    bytes_out: int = 0
+    frames_out: int = 0
+    bytes_in: int = 0
+    frames_in: int = 0
+    queue_frames: int = 0
+    queue_bytes: int = 0
+    queue_overflow: int = 0
+    dials: int = 0
+    connects: int = 0
+    reconnects: int = 0
+    accepts: int = 0
+    frame_errors: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(self.__dict__)
+
+
+class _Outbound:
+    """Dialer-side state toward one peer.
+
+    The resume layer: ``queue`` holds frames not yet written, as
+    ``(orig, wire)`` pairs (``wire`` is a fault-injector-mangled copy to
+    put on the wire ONCE; retransmissions always send ``orig`` — a
+    corrupted transmission models a transient channel fault, not a
+    poisoned message).  ``inflight`` holds originals fully written but
+    not yet covered by the peer's cumulative ACK; after a reconnect the
+    un-acked tail is retransmitted ahead of new traffic, so a surviving
+    peer misses nothing across a disconnect.  ``await_ack`` gates MSG
+    writes on a fresh connection until the acceptor's initial ACK tells
+    us where to resume.
+    """
+
+    __slots__ = (
+        "addr", "sock", "state", "queue", "queue_bytes", "sendbuf",
+        "attempts", "next_dial", "inflight", "inflight_bytes", "acked",
+        "await_ack", "cur_orig", "decoder",
+    )
+
+    def __init__(self, addr: Tuple[str, int]) -> None:
+        self.addr = addr
+        self.sock: Optional[socket.socket] = None
+        self.state = "idle"  # idle | connecting | connected
+        self.queue: collections.deque = collections.deque()  # (orig, wire)
+        self.queue_bytes = 0
+        self.sendbuf = bytearray()
+        self.attempts = 0
+        self.next_dial = 0.0  # monotonic deadline for the next dial try
+        self.inflight: collections.deque = collections.deque()  # orig bytes
+        self.inflight_bytes = 0
+        self.acked = 0
+        self.await_ack = False
+        self.cur_orig: Optional[bytes] = None  # frame currently in sendbuf
+        self.decoder: Optional[FrameDecoder] = None  # ACK stream parser
+
+    def pending_frames(self) -> int:
+        return len(self.queue) + len(self.inflight) + (1 if self.cur_orig else 0)
+
+    def pending_bytes(self) -> int:
+        return self.queue_bytes + self.inflight_bytes + (
+            len(self.cur_orig) if self.cur_orig else 0
+        )
+
+    def has_pending(self) -> bool:
+        return self.pending_frames() > 0
+
+
+class _Inbound:
+    """Acceptor-side state for one accepted connection."""
+
+    __slots__ = ("sock", "decoder", "peer_id", "sendbuf")
+
+    def __init__(self, sock: socket.socket, max_frame_len: int) -> None:
+        self.sock = sock
+        self.decoder = FrameDecoder(max_frame_len)
+        self.peer_id: Any = None
+        self.sendbuf = bytearray()  # pending ACK frames
+
+
+class _ConsumerOverload(Exception):
+    """on_message refused a frame (consumer queue full): drop the
+    connection WITHOUT acking, so the dialer resumes from the acked
+    prefix — the cumulative count means "first n frames consumed" and
+    skipping one frame mid-stream would misalign it forever."""
+
+
+class TcpTransport:
+    def __init__(
+        self,
+        node_id: Any,
+        cluster_id: bytes,
+        peers: Optional[Dict[Any, Tuple[str, int]]] = None,
+        on_message: Optional[Callable[[Any, bytes], None]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_frame_len: int = MAX_FRAME_LEN,
+        max_queue_frames: int = 20_000,
+        max_queue_bytes: int = 64 << 20,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        backoff_jitter: float = 0.3,
+        metrics: Optional[Metrics] = None,
+        injector: Any = None,
+        seed: int = 0,
+        accept_unknown_peers: bool = False,
+    ) -> None:
+        self.node_id = node_id
+        self.cluster_id = cluster_id
+        self.on_message = on_message
+        self.max_frame_len = max_frame_len
+        self.max_queue_frames = max_queue_frames
+        self.max_queue_bytes = max_queue_bytes
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.backoff_jitter = backoff_jitter
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.injector = injector
+        # Per-peer acceptor state (PeerStats, _rx_counts) is keyed by the
+        # HELLO-announced id; without this gate one unauthenticated local
+        # client could grow both maps without bound by announcing fresh
+        # ids.  True is for topologies where inbound peers are not known
+        # up front (joining nodes); the in-process clusters never need it.
+        self.accept_unknown_peers = accept_unknown_peers
+        self._rng = random.Random(f"transport|{seed}|{node_id}")
+        self._host = host
+        self._sel = selectors.DefaultSelector()
+        self._listener: Optional[socket.socket] = None
+        self._port = 0
+        self._bind(host, port)
+        self._out: Dict[Any, _Outbound] = {}
+        for pid, addr in (peers or {}).items():
+            self._out[pid] = _Outbound(tuple(addr))
+        # accepted-connection cap: every peer may hold a live connection
+        # plus a few churning replacements; beyond that is abuse
+        self.max_inbound = 4 * max(1, len(self._out)) + 8
+        self.peer_stats: Dict[Any, PeerStats] = collections.defaultdict(PeerStats)
+        self._inbound: List[_Inbound] = []
+        # Cumulative MSG frames consumed per sending peer, across
+        # reconnects — the number the resume layer ACKs back.  Dies with
+        # the process (a restarted node ACKs 0; dialers adopt the reset).
+        self._rx_counts: Dict[Any, int] = collections.defaultdict(int)
+        # control plane: any thread appends + wakes; loop thread drains
+        self._control: collections.deque = collections.deque()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        self._timers: List[Tuple[float, int, str, Any]] = []
+        self._timer_seq = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self.offline = False
+        self._desired_offline = False  # last requested state (rebind retry)
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def _bind(self, host: str, port: int) -> None:
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(128)
+        ls.setblocking(False)
+        self._listener = ls
+        self._port = ls.getsockname()[1]
+        self._sel.register(ls, selectors.EVENT_READ, ("listen", None))
+
+    def set_peers(self, peers: Dict[Any, Tuple[str, int]]) -> None:
+        """Install the peer address map (before start())."""
+        assert self._thread is None, "set_peers before start"
+        for pid, addr in peers.items():
+            if pid == self.node_id:
+                continue
+            self._out[pid] = _Outbound(tuple(addr))
+        self.max_inbound = 4 * max(1, len(self._out)) + 8
+
+    def start(self) -> None:
+        assert self._thread is None
+        if self.injector is not None:
+            self.injector.start()
+        self._thread = threading.Thread(
+            target=self._run, name=f"transport-{self.node_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._post(("stop", None))
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    def set_offline(self, offline: bool) -> None:
+        """Sever all connections and stop listening/dialing (True), or
+        rebind the same port and resume (False).  Outbound queues are
+        preserved — this simulates a network outage around a live
+        process, the sender-queue churn scenario."""
+        self._post(("offline", bool(offline)))
+
+    # -- data plane (any thread) ---------------------------------------
+    def send(self, dest: Any, payload: bytes) -> None:
+        """Frame + queue one protocol message toward ``dest``.
+
+        Each injector-planned copy becomes its own logical frame; a
+        mangled copy keeps its original alongside so a retransmission
+        (after the receiver drops the corrupted connection) carries the
+        clean bytes — the channel is faulty, the message is not.
+        """
+        frame = encode_frame(KIND_MSG, payload, self.max_frame_len)
+        if self.injector is not None:
+            plan = self.injector.on_send(self.node_id, dest, frame)
+        else:
+            plan = [(0.0, frame)]
+        for delay_s, data in plan:
+            wire = data if data != frame else None
+            self._post(("enqueue", (dest, delay_s, frame, wire)))
+
+    def _post(self, item: Tuple[str, Any]) -> None:
+        self._control.append(item)
+        try:
+            self._wake_w.send(b"\x00")
+        except BlockingIOError:
+            pass  # a wakeup byte is already pending
+        except OSError:
+            pass  # loop already torn down
+
+    # -- stats ---------------------------------------------------------
+    def stats(self) -> Dict[Any, Dict[str, int]]:
+        # list(): the loop thread inserts new peers concurrently
+        return {pid: st.as_dict() for pid, st in list(self.peer_stats.items())}
+
+    def export_metrics(self) -> Metrics:
+        """Refresh per-peer gauges/counters in :attr:`metrics`."""
+        m = self.metrics
+        for pid, st in list(self.peer_stats.items()):
+            base = f"transport.{self.node_id}->{pid}"
+            m.gauge(f"{base}.queue_frames", st.queue_frames)
+            m.gauge(f"{base}.queue_bytes", st.queue_bytes)
+            m.gauge(f"{base}.bytes_out", st.bytes_out)
+            m.gauge(f"{base}.frames_out", st.frames_out)
+            m.gauge(f"{base}.bytes_in", st.bytes_in)
+            m.gauge(f"{base}.frames_in", st.frames_in)
+            m.gauge(f"{base}.reconnects", st.reconnects)
+            m.gauge(f"{base}.frame_errors", st.frame_errors)
+        return m
+
+    # -- event loop ----------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while True:
+                timeout = self._next_timeout()
+                for key, events in self._sel.select(timeout):
+                    kind, data = key.data
+                    if kind == "wake":
+                        try:
+                            while self._wake_r.recv(RECV_CHUNK):
+                                pass
+                        except BlockingIOError:
+                            pass
+                    elif kind == "listen":
+                        self._accept()
+                    elif kind == "in":
+                        if events & selectors.EVENT_READ:
+                            self._read_inbound(data)
+                        if data.sock is not None and events & selectors.EVENT_WRITE:
+                            self._flush_inbound(data)
+                    elif kind == "out":
+                        self._service_outbound(data, events)
+                if self._drain_control():
+                    return  # stop requested
+                self._fire_timers()
+        finally:
+            self._teardown()
+
+    def _next_timeout(self) -> Optional[float]:
+        if self._control:
+            return 0.0
+        if not self._timers:
+            return 0.5
+        return max(0.0, min(0.5, self._timers[0][0] - time.monotonic()))
+
+    def _drain_control(self) -> bool:
+        while self._control:
+            op, arg = self._control.popleft()
+            if op == "stop":
+                return True
+            if op == "enqueue":
+                dest, delay_s, orig, wire = arg
+                if delay_s > 0:
+                    self._add_timer(delay_s, "enqueue", (dest, orig, wire))
+                else:
+                    self._enqueue(dest, orig, wire)
+            elif op == "offline":
+                self._desired_offline = bool(arg)
+                self._go_offline() if arg else self._go_online()
+        return False
+
+    def _add_timer(self, delay_s: float, kind: str, arg: Any) -> None:
+        heapq.heappush(
+            self._timers,
+            (time.monotonic() + delay_s, next(self._timer_seq), kind, arg),
+        )
+
+    def _fire_timers(self) -> None:
+        now = time.monotonic()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, kind, arg = heapq.heappop(self._timers)
+            if kind == "enqueue":
+                self._enqueue(*arg)
+            elif kind == "dial":
+                ob = self._out.get(arg)
+                if (
+                    ob is not None
+                    and ob.state == "idle"
+                    and not self.offline
+                    and ob.has_pending()
+                ):
+                    self._dial(arg, ob)
+            elif kind == "rebind":
+                if self.offline and not self._desired_offline:
+                    self._go_online()
+
+    # -- outbound ------------------------------------------------------
+    def _enqueue(self, dest: Any, orig: bytes, wire: Optional[bytes]) -> None:
+        ob = self._out.get(dest)
+        if ob is None:
+            self.metrics.count("transport.unknown_dest")
+            return
+        st = self.peer_stats[dest]
+        # inflight counts toward BOTH caps: the resume layer retains
+        # unacked frames, and retention must stay bounded too (a peer
+        # that reads but stops ACKing must not grow memory past the cap)
+        if (
+            ob.pending_frames() >= self.max_queue_frames
+            or ob.pending_bytes() + len(orig) > self.max_queue_bytes
+        ):
+            st.queue_overflow += 1
+            self.metrics.count("transport.queue_overflow")
+            return
+        ob.queue.append((orig, wire))
+        ob.queue_bytes += len(orig)
+        st.queue_frames = len(ob.queue)
+        st.queue_bytes = ob.queue_bytes
+        if ob.state == "idle" and not self.offline:
+            now = time.monotonic()
+            if now >= ob.next_dial:
+                self._dial(dest, ob)
+            # else: a backoff timer is already pending
+        elif ob.state == "connected":
+            self._want_write(ob, True)
+
+    def _dial(self, dest: Any, ob: _Outbound) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.peer_stats[dest].dials += 1
+        try:
+            sock.connect_ex(ob.addr)
+        except OSError:
+            sock.close()
+            self._schedule_redial(dest, ob)
+            return
+        ob.sock = sock
+        ob.state = "connecting"
+        self._sel.register(
+            sock, selectors.EVENT_WRITE | selectors.EVENT_READ, ("out", dest)
+        )
+
+    def _schedule_redial(self, dest: Any, ob: _Outbound) -> None:
+        ob.attempts += 1
+        delay = min(
+            self.backoff_cap_s, self.backoff_base_s * (2 ** (ob.attempts - 1))
+        )
+        delay *= 1.0 + self.backoff_jitter * self._rng.random()
+        ob.next_dial = time.monotonic() + delay
+        self._add_timer(delay, "dial", dest)
+
+    def _service_outbound(self, dest: Any, events: int) -> None:
+        ob = self._out.get(dest)
+        if ob is None or ob.sock is None:
+            return
+        st = self.peer_stats[dest]
+        if ob.state == "connecting":
+            err = ob.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+            if err:
+                self._drop_outbound(dest, ob, redial=True)
+                return
+            ob.state = "connected"
+            ob.attempts = 0
+            ob.await_ack = True  # resume point comes from the peer's ACK
+            ob.decoder = FrameDecoder(self.max_frame_len)
+            st.connects += 1
+            if st.connects > 1:
+                st.reconnects += 1
+                self.metrics.count("transport.reconnects")
+            # handshake first, then whatever queued up
+            ob.sendbuf += encode_hello(
+                self.node_id, self.cluster_id, self.max_frame_len
+            )
+        if events & selectors.EVENT_READ and ob.state == "connected":
+            # the reverse direction carries only cumulative ACKs
+            try:
+                got = ob.sock.recv(RECV_CHUNK)
+            except BlockingIOError:
+                got = None  # spurious readable wakeup: NOT an EOF
+            except OSError:
+                got = b""
+            if got == b"":
+                self._drop_outbound(dest, ob, redial=True)
+                return
+            try:
+                ob.decoder.feed(got or b"")
+                for kind, payload in ob.decoder.frames():
+                    if kind != KIND_ACK:
+                        raise FrameError("only ACK frames flow dialer-ward")
+                    self._handle_ack(dest, ob, decode_ack(payload))
+            except FrameError:
+                self.metrics.count("transport.frame_errors")
+                st.frame_errors += 1
+                self._drop_outbound(dest, ob, redial=True)
+                return
+        self._flush_outbound(dest, ob)
+
+    def _handle_ack(self, dest: Any, ob: _Outbound, n: int) -> None:
+        """Apply a cumulative consumed-count from the acceptor."""
+        while ob.inflight and ob.acked < n:
+            ob.inflight_bytes -= len(ob.inflight.popleft())
+            ob.acked += 1
+        if n < ob.acked:
+            # peer lost its counter (process restart): adopt its origin;
+            # we can only replay what we still hold
+            ob.acked = n
+        elif n > ob.acked:
+            # WE lost our counter (our restart, their surviving count):
+            # resync so future ACKs pop exactly the frames they cover —
+            # leaving acked behind would make `acked < n` drain frames
+            # the peer never consumed
+            ob.acked = n
+        if ob.await_ack:
+            ob.await_ack = False
+            # retransmit the unacked tail ahead of new traffic (originals
+            # only — any corruption belonged to the dead connection)
+            if ob.inflight:
+                retrans = [(data, None) for data in ob.inflight]
+                ob.inflight.clear()
+                ob.inflight_bytes = 0
+                ob.queue.extendleft(reversed(retrans))
+                ob.queue_bytes += sum(len(d) for d, _ in retrans)
+
+    def _flush_outbound(self, dest: Any, ob: _Outbound) -> None:
+        if ob.state != "connected" or ob.sock is None:
+            return
+        st = self.peer_stats[dest]
+        while ob.sendbuf or (ob.queue and not ob.await_ack):
+            if not ob.sendbuf:
+                orig, wire = ob.queue.popleft()
+                ob.queue_bytes -= len(orig)
+                ob.sendbuf += wire if wire is not None else orig
+                ob.cur_orig = orig
+                st.frames_out += 1
+            try:
+                n = ob.sock.send(ob.sendbuf)
+            except BlockingIOError:
+                break
+            except OSError:
+                self._drop_outbound(dest, ob, redial=True)
+                return
+            if n == 0:
+                break
+            st.bytes_out += n
+            del ob.sendbuf[:n]
+            if not ob.sendbuf and ob.cur_orig is not None:
+                # fully written: retained until the peer's ACK covers it
+                ob.inflight.append(ob.cur_orig)
+                ob.inflight_bytes += len(ob.cur_orig)
+                ob.cur_orig = None
+        st.queue_frames = len(ob.queue)
+        st.queue_bytes = ob.queue_bytes
+        self._want_write(ob, bool(ob.sendbuf or (ob.queue and not ob.await_ack)))
+
+    def _want_write(self, ob: _Outbound, want: bool) -> None:
+        if ob.sock is None or ob.state != "connected":
+            return
+        events = selectors.EVENT_READ | (selectors.EVENT_WRITE if want else 0)
+        try:
+            self._sel.modify(ob.sock, events, self._sel.get_key(ob.sock).data)
+        except (KeyError, ValueError):
+            pass
+
+    def _drop_outbound(self, dest: Any, ob: _Outbound, redial: bool) -> None:
+        if ob.sock is not None:
+            try:
+                self._sel.unregister(ob.sock)
+            except (KeyError, ValueError):
+                pass
+            ob.sock.close()
+            ob.sock = None
+        ob.state = "idle"
+        ob.decoder = None
+        ob.await_ack = False
+        # a partially-written frame dies with its connection (the wire
+        # remainder would desync the peer), but its ORIGINAL goes back
+        # to the queue head — the peer never consumed it
+        ob.sendbuf.clear()
+        if ob.cur_orig is not None:
+            ob.queue.appendleft((ob.cur_orig, None))
+            ob.queue_bytes += len(ob.cur_orig)
+            ob.cur_orig = None
+        if redial and not self.offline and ob.has_pending():
+            self._schedule_redial(dest, ob)
+
+    # -- inbound -------------------------------------------------------
+    def _accept(self) -> None:
+        if self._listener is None:
+            return
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            # bound accepted-connection state: each connection can buffer
+            # up to max_frame_len before any frame completes, so an
+            # unbounded accept loop is an easy local memory DoS
+            if len(self._inbound) >= self.max_inbound:
+                self.metrics.count("transport.accept_overflow")
+                sock.close()
+                continue
+            sock.setblocking(False)
+            conn = _Inbound(sock, self.max_frame_len)
+            self._inbound.append(conn)
+            self._sel.register(sock, selectors.EVENT_READ, ("in", conn))
+
+    def _read_inbound(self, conn: _Inbound) -> None:
+        if conn.sock is None:
+            return
+        try:
+            data = conn.sock.recv(RECV_CHUNK)
+        except BlockingIOError:
+            return
+        except OSError:
+            data = b""
+        if data == b"":
+            self._close_inbound(conn)
+            return
+        if conn.peer_id is not None:
+            self.peer_stats[conn.peer_id].bytes_in += len(data)
+        consumed_before = (
+            self._rx_counts[conn.peer_id] if conn.peer_id is not None else 0
+        )
+        try:
+            conn.decoder.feed(data)
+            for kind, payload in conn.decoder.frames():
+                self._handle_frame(conn, kind, payload)
+        except FrameError:
+            self.metrics.count("transport.frame_errors")
+            if conn.peer_id is not None:
+                self.peer_stats[conn.peer_id].frame_errors += 1
+            self._close_inbound(conn)
+            return
+        except _ConsumerOverload:
+            # receive-side backpressure: the consumer queue is full, so
+            # stop consuming at a prefix point; the peer's reconnect +
+            # retransmit (paced by dial backoff) delivers the rest later
+            self.metrics.count("transport.consumer_overflow")
+            self._close_inbound(conn)
+            return
+        # one cumulative ACK per read burst that consumed MSG frames
+        if (
+            conn.peer_id is not None
+            and self._rx_counts[conn.peer_id] != consumed_before
+        ):
+            conn.sendbuf += encode_ack(self._rx_counts[conn.peer_id])
+            self._flush_inbound(conn)
+
+    def _handle_frame(self, conn: _Inbound, kind: int, payload: bytes) -> None:
+        if conn.peer_id is None:
+            if kind != KIND_HELLO:
+                raise FrameError("first frame must be HELLO")
+            announced = decode_hello(payload, self.cluster_id)
+            if announced not in self._out and not self.accept_unknown_peers:
+                raise FrameError(f"HELLO from unconfigured peer {announced!r}")
+            # A fresh HELLO supersedes any stale connection from the same
+            # peer: close it WITHOUT consuming its buffered frames.  The
+            # cumulative count is shared per peer id — draining a dead
+            # connection after ACKing the new one would double-count
+            # frames the dialer retransmits (it treats them as unacked),
+            # over-acknowledging and breaking the lossless-resume
+            # guarantee.  Unconsumed frames are covered by retransmit.
+            for stale in list(self._inbound):
+                if stale is not conn and stale.peer_id == announced:
+                    self._close_inbound(stale)
+            conn.peer_id = announced
+            self.peer_stats[conn.peer_id].accepts += 1
+            self.metrics.count("transport.accepts")
+            # initial ACK = the dialer's resume point
+            conn.sendbuf += encode_ack(self._rx_counts[conn.peer_id])
+            self._flush_inbound(conn)
+            return
+        if kind == KIND_HELLO:
+            raise FrameError("duplicate HELLO")
+        if kind == KIND_ACK:
+            raise FrameError("ACK frames only flow acceptor->dialer")
+        st = self.peer_stats[conn.peer_id]
+        st.frames_in += 1
+        if self.on_message is not None:
+            try:
+                res = self.on_message(conn.peer_id, payload)
+            except Exception:
+                # the consumer's problem must not kill the socket plane;
+                # a poison frame is counted and acked (never retransmit
+                # what deterministically explodes)
+                self.metrics.count("transport.on_message_errors")
+                res = None
+            if res is False:
+                raise _ConsumerOverload()
+        # consumed == handed to the node's inbox; the frame now survives
+        # a disconnect on our side, so it is safe to acknowledge
+        self._rx_counts[conn.peer_id] += 1
+
+    def _flush_inbound(self, conn: _Inbound) -> None:
+        if conn.sock is None:
+            return
+        try:
+            while conn.sendbuf:
+                n = conn.sock.send(conn.sendbuf)
+                if n == 0:
+                    break
+                del conn.sendbuf[:n]
+        except BlockingIOError:
+            pass
+        except OSError:
+            self._close_inbound(conn)
+            return
+        events = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if conn.sendbuf else 0
+        )
+        try:
+            self._sel.modify(conn.sock, events, ("in", conn))
+        except (KeyError, ValueError):
+            pass
+
+    def _close_inbound(self, conn: _Inbound) -> None:
+        if conn.sock is None:
+            return
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.sock.close()
+        conn.sock = None
+        if conn in self._inbound:
+            self._inbound.remove(conn)
+
+    # -- offline / teardown --------------------------------------------
+    def _go_offline(self) -> None:
+        if self.offline:
+            return
+        self.offline = True
+        if self._listener is not None:
+            try:
+                self._sel.unregister(self._listener)
+            except (KeyError, ValueError):
+                pass
+            self._listener.close()
+            self._listener = None
+        for conn in list(self._inbound):
+            self._close_inbound(conn)
+        for dest, ob in self._out.items():
+            if ob.sock is not None:
+                self._drop_outbound(dest, ob, redial=False)
+            ob.attempts = 0
+            ob.next_dial = 0.0
+
+    def _go_online(self) -> None:
+        if not self.offline:
+            return
+        try:
+            self._bind(self._host, self._port)
+        except OSError:
+            # the freed port can be transiently taken (another process
+            # raced it, or lingering TIME_WAIT states on some stacks);
+            # stay offline and retry — an escaped exception here would
+            # silently kill the whole selector thread
+            self.metrics.count("transport.rebind_errors")
+            self._add_timer(0.5, "rebind", None)
+            return
+        self.offline = False
+        for dest, ob in self._out.items():
+            if ob.has_pending():  # queued OR unacked-inflight frames
+                self._dial(dest, ob)
+
+    def _teardown(self) -> None:
+        self._stopping = True
+        for conn in list(self._inbound):
+            self._close_inbound(conn)
+        for dest, ob in self._out.items():
+            if ob.sock is not None:
+                self._drop_outbound(dest, ob, redial=False)
+        if self._listener is not None:
+            try:
+                self._sel.unregister(self._listener)
+            except (KeyError, ValueError):
+                pass
+            self._listener.close()
+            self._listener = None
+        self._wake_r.close()
+        self._wake_w.close()
+        self._sel.close()

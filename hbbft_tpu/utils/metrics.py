@@ -23,11 +23,12 @@ can be captured for TensorBoard without importing jax at module scope.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 
 @dataclass
@@ -48,13 +49,31 @@ class TimerStats:
 
 @dataclass
 class Metrics:
-    """Counters + timers; cheap enough to leave on."""
+    """Counters + gauges + timers; cheap enough to leave on.
+
+    Mutations are lock-protected: one instance is routinely shared
+    between a transport's selector thread and its node's protocol
+    thread (hbbft_tpu/transport/), and ``+=`` on a dict entry is not
+    atomic across bytecodes — concurrent same-key counts would lose
+    increments without the lock.
+    """
 
     counters: Dict[str, int] = field(default_factory=lambda: defaultdict(int))
     timers: Dict[str, TimerStats] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def count(self, name: str, n: int = 1) -> None:
-        self.counters[name] += n
+        with self._lock:
+            self.counters[name] += n
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time observable (queue depth, bytes buffered);
+        last write wins, unlike the monotonic counters."""
+        with self._lock:
+            self.gauges[name] = value
 
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -63,7 +82,8 @@ class Metrics:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.timers.setdefault(name, TimerStats()).add(dt)
+            with self._lock:
+                self.timers.setdefault(name, TimerStats()).add(dt)
 
     @contextmanager
     def trace(self, logdir: str) -> Iterator[None]:
@@ -77,29 +97,112 @@ class Metrics:
             yield
 
     def merge(self, other: "Metrics") -> None:
-        for k, v in other.counters.items():
-            self.counters[k] += v
-        for k, st in other.timers.items():
-            mine = self.timers.setdefault(k, TimerStats())
-            mine.count += st.count
-            mine.total_s += st.total_s
-            mine.max_s = max(mine.max_s, st.max_s)
+        # list() snapshots: ``other`` may belong to a live transport or
+        # protocol thread that inserts new keys mid-merge (GIL makes the
+        # item reads safe; iterating the live dict would not be)
+        with self._lock:
+            for k, v in list(other.counters.items()):
+                self.counters[k] += v
+            for k, st in list(other.timers.items()):
+                mine = self.timers.setdefault(k, TimerStats())
+                mine.count += st.count
+                mine.total_s += st.total_s
+                mine.max_s = max(mine.max_s, st.max_s)
+            # gauges are point-in-time: the merged-in value wins (merge
+            # order is "newer last" everywhere this is used)
+            self.gauges.update(list(other.gauges.items()))
+
+    def _snapshot(self) -> Tuple[Dict[str, int], Dict[str, float], Dict[str, TimerStats]]:
+        """Consistent copies for the export methods — they may run on a
+        scrape thread while the owning threads keep inserting keys."""
+        with self._lock:
+            return dict(self.counters), dict(self.gauges), dict(self.timers)
 
     def report(self) -> str:
+        counters, gauges, timers = self._snapshot()
         lines = []
-        if self.counters:
+        if counters:
             lines.append("counters:")
-            for k in sorted(self.counters):
-                lines.append(f"  {k:<40} {self.counters[k]}")
-        if self.timers:
+            for k in sorted(counters):
+                lines.append(f"  {k:<40} {counters[k]}")
+        if gauges:
+            lines.append("gauges:")
+            for k in sorted(gauges):
+                lines.append(f"  {k:<40} {gauges[k]:.12g}")
+        if timers:
             lines.append("timers:  (count / mean ms / max ms / total s)")
-            for k in sorted(self.timers):
-                st = self.timers[k]
+            for k in sorted(timers):
+                st = timers[k]
                 lines.append(
                     f"  {k:<40} {st.count:>6} {st.mean_s * 1e3:>9.2f} "
                     f"{st.max_s * 1e3:>9.2f} {st.total_s:>8.2f}"
                 )
         return "\n".join(lines) or "(no metrics)"
+
+    # -- exports --------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        """Plain-data snapshot (counters, gauges, timer stats) for JSON
+        benchmark lines (benchmarks/scale_native.py,
+        benchmarks/config6_tcp_cluster.py dump this)."""
+        counters, gauges, timers = self._snapshot()
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "timers": {
+                k: {
+                    "count": st.count,
+                    "total_s": st.total_s,
+                    "mean_s": st.mean_s,
+                    "max_s": st.max_s,
+                }
+                for k, st in timers.items()
+            },
+        }
+
+    def prometheus_text(self, prefix: str = "hbbft") -> str:
+        """Prometheus exposition format (text/plain version 0.0.4).
+
+        Dotted/arrow metric names ride in a ``name`` label (labels admit
+        any UTF-8) under three fixed metric families, so per-peer series
+        (``transport.0->1.queue_frames``) stay distinguishable without
+        name mangling.  Label values are escaped per the exposition
+        format (backslash, quote, newline) — metric names can embed
+        peer-announced node ids, which are untrusted.
+        """
+
+        def esc(name: str) -> str:
+            return (
+                name.replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        counters, gauges, timers = self._snapshot()
+        lines: List[str] = []
+        if counters:
+            lines.append(f"# TYPE {prefix}_count counter")
+            for k in sorted(counters):
+                lines.append(f'{prefix}_count{{name="{esc(k)}"}} {counters[k]}')
+        if gauges:
+            lines.append(f"# TYPE {prefix}_gauge gauge")
+            for k in sorted(gauges):
+                # .12g, not :g — byte totals exported as gauges exceed
+                # :g's 6 significant digits and would scrape corrupted
+                lines.append(
+                    f'{prefix}_gauge{{name="{esc(k)}"}} {gauges[k]:.12g}'
+                )
+        if timers:
+            lines.append(f"# TYPE {prefix}_timer_seconds summary")
+            for k in sorted(timers):
+                st = timers[k]
+                lines.append(
+                    f'{prefix}_timer_seconds_count{{name="{esc(k)}"}} {st.count}'
+                )
+                lines.append(
+                    f'{prefix}_timer_seconds_sum{{name="{esc(k)}"}} '
+                    f"{st.total_s:.12g}"
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
 
 
 @dataclass

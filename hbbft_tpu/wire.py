@@ -18,6 +18,28 @@ Registered types (everything reachable from a committed contribution):
             ``InternalContrib``, ``JoinPlan``
 * sync_key_gen:  ``Part``, ``Ack``
 
+Transport-boundary types (everything reachable from a live wire
+message of the SenderQueue(QueueingHoneyBadger) stack, so a whole
+protocol message can ride in one TCP frame —
+:mod:`hbbft_tpu.transport.framing`):
+
+* crypto shares:  ``SignatureShare``, ``DecryptionShare``
+* merkle:   ``Proof``
+* broadcast:  ``ValueMsg``, ``EchoMsg``, ``ReadyMsg``, ``EchoHashMsg``,
+            ``CanDecodeMsg``
+* agreement:  ``BoolSet``, ``BValMsg``, ``AuxMsg``, ``ConfMsg``,
+            ``CoinMsg``, ``TermMsg``, ``AbaMessage``
+* threshold:  ``SignMessage``, ``DecryptMessage``
+* envelopes:  ``SubsetMessage``, ``HbMessage``, ``DhbMessage``,
+            ``SqMessage``
+
+These unpackers are *stricter* than the in-process handlers: a frame
+whose payload could only have been authored by a broken or malicious
+peer (wrong root length, round < 0, unknown envelope kind) is rejected
+at the decode boundary — the transport drops the connection and counts
+the fault — instead of being handed to a protocol instance.  Handlers
+keep their own malformed-message fault paths for in-process use.
+
 Group elements are encoded by the serde core (tag 0x11) through the suite
 registry; suites validate structure/on-curve/subgroup in
 ``g1_from_bytes``/``g2_from_bytes``.
@@ -40,18 +62,45 @@ from __future__ import annotations
 
 from typing import Any
 
-from hbbft_tpu.crypto.keys import Ciphertext, PublicKey, Signature
+from hbbft_tpu.crypto.keys import (
+    Ciphertext,
+    DecryptionShare,
+    PublicKey,
+    Signature,
+    SignatureShare,
+)
 from hbbft_tpu.crypto.poly import BivarCommitment, Commitment
 from hbbft_tpu.crypto.suite import ScalarG, ScalarSuite
+from hbbft_tpu.ops.merkle import Proof
+from hbbft_tpu.protocols.binary_agreement import (
+    AbaMessage,
+    ConfMsg,
+    CoinMsg,
+    TermMsg,
+)
+from hbbft_tpu.protocols.bool_set import BoolSet
+from hbbft_tpu.protocols.broadcast import (
+    CanDecodeMsg,
+    EchoHashMsg,
+    EchoMsg,
+    ReadyMsg,
+    ValueMsg,
+)
 from hbbft_tpu.protocols.dynamic_honey_badger import (
     Change,
+    DhbMessage,
     InternalContrib,
     JoinPlan,
     SignedKeyGenMsg,
     SignedVote,
 )
-from hbbft_tpu.protocols.honey_badger import EncryptionSchedule
+from hbbft_tpu.protocols.honey_badger import DECRYPT, SUBSET, EncryptionSchedule, HbMessage
+from hbbft_tpu.protocols.sbv_broadcast import AuxMsg, BValMsg
+from hbbft_tpu.protocols.sender_queue import SqMessage
+from hbbft_tpu.protocols.subset import BA, BC, SubsetMessage
 from hbbft_tpu.protocols.sync_key_gen import Ack, Part
+from hbbft_tpu.protocols.threshold_decrypt import DecryptMessage
+from hbbft_tpu.protocols.threshold_sign import SignMessage
 from hbbft_tpu.utils import serde
 from hbbft_tpu.utils.serde import (
     DecodeError,
@@ -424,6 +473,257 @@ def _unpack_ack(f: tuple) -> Ack:
     return Ack(proposer, values)
 
 
+# -- transport-boundary types (live wire messages) --------------------------
+
+
+def _bool(v: Any, what: str) -> bool:
+    _need(type(v) is bool, f"{what}: not a bool")
+    return v
+
+
+def _root(v: Any, what: str) -> bytes:
+    _need(type(v) is bytes and len(v) == 32, f"{what}: not a 32-byte root")
+    return v
+
+
+def _pack_sig_share(s: SignatureShare) -> tuple:
+    return (s.suite.name, s.g2)
+
+
+def _unpack_sig_share(f: tuple) -> SignatureShare:
+    name, g2 = _fields(f, 2, "SignatureShare")
+    suite = _suite(name)
+    return SignatureShare(_g2(suite, g2, "SignatureShare.g2"), suite)
+
+
+def _pack_dec_share(s: DecryptionShare) -> tuple:
+    return (s.suite.name, s.g1)
+
+
+def _unpack_dec_share(f: tuple) -> DecryptionShare:
+    name, g1 = _fields(f, 2, "DecryptionShare")
+    suite = _suite(name)
+    return DecryptionShare(_g1(suite, g1, "DecryptionShare.g1"), suite)
+
+
+def _pack_proof(p: Proof) -> tuple:
+    return (p.value, p.index, p.path, p.root)
+
+
+def _unpack_proof(f: tuple) -> Proof:
+    value, index, path, root = _fields(f, 4, "Proof")
+    _bytes(value, "Proof.value")
+    _nonneg(index, "Proof.index")
+    _need(
+        type(path) is tuple
+        and all(type(h) is bytes and len(h) == 32 for h in path),
+        "Proof.path: not a tuple of 32-byte hashes",
+    )
+    return Proof(value, index, path, _root(root, "Proof.root"))
+
+
+def _pack_value_msg(m: ValueMsg) -> tuple:
+    return (m.proof,)
+
+
+def _unpack_value_msg(f: tuple) -> ValueMsg:
+    (proof,) = _fields(f, 1, "ValueMsg")
+    _need(isinstance(proof, Proof), "ValueMsg: bad proof")
+    return ValueMsg(proof)
+
+
+def _pack_echo_msg(m: EchoMsg) -> tuple:
+    return (m.proof,)
+
+
+def _unpack_echo_msg(f: tuple) -> EchoMsg:
+    (proof,) = _fields(f, 1, "EchoMsg")
+    _need(isinstance(proof, Proof), "EchoMsg: bad proof")
+    return EchoMsg(proof)
+
+
+def _pack_root_msg(m: Any) -> tuple:
+    return (m.root,)
+
+
+def _unpack_ready_msg(f: tuple) -> ReadyMsg:
+    (root,) = _fields(f, 1, "ReadyMsg")
+    return ReadyMsg(_root(root, "ReadyMsg.root"))
+
+
+def _unpack_echo_hash_msg(f: tuple) -> EchoHashMsg:
+    (root,) = _fields(f, 1, "EchoHashMsg")
+    return EchoHashMsg(_root(root, "EchoHashMsg.root"))
+
+
+def _unpack_can_decode_msg(f: tuple) -> CanDecodeMsg:
+    (root,) = _fields(f, 1, "CanDecodeMsg")
+    return CanDecodeMsg(_root(root, "CanDecodeMsg.root"))
+
+
+def _pack_bool_set(b: BoolSet) -> tuple:
+    return (b.mask,)
+
+
+def _unpack_bool_set(f: tuple) -> BoolSet:
+    (mask,) = _fields(f, 1, "BoolSet")
+    _need(type(mask) is int and 0 <= mask <= 3, "BoolSet: bad mask")
+    return BoolSet(mask)
+
+
+def _pack_bval_msg(m: BValMsg) -> tuple:
+    return (m.value,)
+
+
+def _unpack_bval_msg(f: tuple) -> BValMsg:
+    (value,) = _fields(f, 1, "BValMsg")
+    return BValMsg(_bool(value, "BValMsg.value"))
+
+
+def _pack_aux_msg(m: AuxMsg) -> tuple:
+    return (m.value,)
+
+
+def _unpack_aux_msg(f: tuple) -> AuxMsg:
+    (value,) = _fields(f, 1, "AuxMsg")
+    return AuxMsg(_bool(value, "AuxMsg.value"))
+
+
+def _pack_conf_msg(m: ConfMsg) -> tuple:
+    return (m.vals,)
+
+
+def _unpack_conf_msg(f: tuple) -> ConfMsg:
+    (vals,) = _fields(f, 1, "ConfMsg")
+    _need(isinstance(vals, BoolSet), "ConfMsg: bad vals")
+    return ConfMsg(vals)
+
+
+def _pack_term_msg(m: TermMsg) -> tuple:
+    return (m.value,)
+
+
+def _unpack_term_msg(f: tuple) -> TermMsg:
+    (value,) = _fields(f, 1, "TermMsg")
+    return TermMsg(_bool(value, "TermMsg.value"))
+
+
+def _pack_sign_msg(m: SignMessage) -> tuple:
+    return (m.share,)
+
+
+def _unpack_sign_msg(f: tuple) -> SignMessage:
+    (share,) = _fields(f, 1, "SignMessage")
+    _need(isinstance(share, SignatureShare), "SignMessage: bad share")
+    return SignMessage(share)
+
+
+def _pack_coin_msg(m: CoinMsg) -> tuple:
+    return (m.inner,)
+
+
+def _unpack_coin_msg(f: tuple) -> CoinMsg:
+    (inner,) = _fields(f, 1, "CoinMsg")
+    _need(isinstance(inner, SignMessage), "CoinMsg: bad inner")
+    return CoinMsg(inner)
+
+
+def _pack_decrypt_msg(m: DecryptMessage) -> tuple:
+    return (m.share,)
+
+
+def _unpack_decrypt_msg(f: tuple) -> DecryptMessage:
+    (share,) = _fields(f, 1, "DecryptMessage")
+    _need(isinstance(share, DecryptionShare), "DecryptMessage: bad share")
+    return DecryptMessage(share)
+
+
+def _pack_aba_msg(m: AbaMessage) -> tuple:
+    return (m.round, m.content)
+
+
+def _unpack_aba_msg(f: tuple) -> AbaMessage:
+    rnd, content = _fields(f, 2, "AbaMessage")
+    # explicit type tuple (not the _ABA_CONTENT alias): the HBT005
+    # delegation analysis reads isinstance targets by name
+    _need(
+        isinstance(content, (BValMsg, AuxMsg, ConfMsg, CoinMsg, TermMsg)),
+        "AbaMessage: bad content",
+    )
+    return AbaMessage(_nonneg(rnd, "AbaMessage.round"), content)
+
+
+_BC_CONTENT = (ValueMsg, EchoMsg, ReadyMsg, EchoHashMsg, CanDecodeMsg)
+
+
+def _pack_subset_msg(m: SubsetMessage) -> tuple:
+    return (m.proposer, m.kind, m.inner)
+
+
+def _unpack_subset_msg(f: tuple) -> SubsetMessage:
+    proposer, kind, inner = _fields(f, 3, "SubsetMessage")
+    _node_id(proposer, "SubsetMessage.proposer")
+    if kind == BC:
+        _need(isinstance(inner, _BC_CONTENT), "SubsetMessage: bad bc inner")
+    elif kind == BA:
+        _need(isinstance(inner, AbaMessage), "SubsetMessage: bad ba inner")
+    else:
+        raise DecodeError("SubsetMessage: bad kind")
+    return SubsetMessage(proposer, kind, inner)
+
+
+def _pack_hb_msg(m: HbMessage) -> tuple:
+    return (m.epoch, m.kind, m.proposer, m.inner)
+
+
+def _unpack_hb_msg(f: tuple) -> HbMessage:
+    epoch, kind, proposer, inner = _fields(f, 4, "HbMessage")
+    _nonneg(epoch, "HbMessage.epoch")
+    if kind == SUBSET:
+        _need(proposer is None, "HbMessage: subset with proposer")
+        _need(isinstance(inner, SubsetMessage), "HbMessage: bad subset inner")
+    elif kind == DECRYPT:
+        _node_id(proposer, "HbMessage.proposer")
+        _need(isinstance(inner, DecryptMessage), "HbMessage: bad decrypt inner")
+    else:
+        raise DecodeError("HbMessage: bad kind")
+    return HbMessage(epoch, kind, proposer, inner)
+
+
+def _pack_dhb_msg(m: DhbMessage) -> tuple:
+    return (m.era, m.inner)
+
+
+def _unpack_dhb_msg(f: tuple) -> DhbMessage:
+    era, inner = _fields(f, 2, "DhbMessage")
+    _need(isinstance(inner, HbMessage), "DhbMessage: bad inner")
+    return DhbMessage(_nonneg(era, "DhbMessage.era"), inner)
+
+
+def _pack_sq_msg(m: SqMessage) -> tuple:
+    return (m.kind, m.value)
+
+
+def _unpack_sq_msg(f: tuple) -> SqMessage:
+    kind, value = _fields(f, 2, "SqMessage")
+    if kind == "epoch_started":
+        _need(
+            type(value) is tuple
+            and len(value) == 2
+            and all(type(x) is int and x >= 0 for x in value),
+            "SqMessage: bad epoch",
+        )
+    elif kind == "algo":
+        # Both the dynamic (DhbMessage) and static (HbMessage) stacks
+        # ride through SenderQueue.
+        _need(isinstance(value, (DhbMessage, HbMessage)), "SqMessage: bad algo")
+    elif kind == "join_plan":
+        _need(isinstance(value, JoinPlan), "SqMessage: bad plan")
+    else:
+        raise DecodeError("SqMessage: bad kind")
+    return SqMessage(kind, value)
+
+
 # -- registration -----------------------------------------------------------
 
 register_struct("ct", Ciphertext, _pack_ciphertext, _unpack_ciphertext)
@@ -444,3 +744,28 @@ register_struct(
 register_struct("joinplan", JoinPlan, _pack_join_plan, _unpack_join_plan)
 register_struct("part", Part, _pack_part, _unpack_part)
 register_struct("ack", Ack, _pack_ack, _unpack_ack)
+
+# transport-boundary (live wire) types
+register_struct("sigshare", SignatureShare, _pack_sig_share, _unpack_sig_share)
+register_struct("decshare", DecryptionShare, _pack_dec_share, _unpack_dec_share)
+register_struct("proof", Proof, _pack_proof, _unpack_proof)
+register_struct("bc_value", ValueMsg, _pack_value_msg, _unpack_value_msg)
+register_struct("bc_echo", EchoMsg, _pack_echo_msg, _unpack_echo_msg)
+register_struct("bc_ready", ReadyMsg, _pack_root_msg, _unpack_ready_msg)
+register_struct("bc_echohash", EchoHashMsg, _pack_root_msg, _unpack_echo_hash_msg)
+register_struct(
+    "bc_candecode", CanDecodeMsg, _pack_root_msg, _unpack_can_decode_msg
+)
+register_struct("bools", BoolSet, _pack_bool_set, _unpack_bool_set)
+register_struct("ba_bval", BValMsg, _pack_bval_msg, _unpack_bval_msg)
+register_struct("ba_aux", AuxMsg, _pack_aux_msg, _unpack_aux_msg)
+register_struct("ba_conf", ConfMsg, _pack_conf_msg, _unpack_conf_msg)
+register_struct("ba_coin", CoinMsg, _pack_coin_msg, _unpack_coin_msg)
+register_struct("ba_term", TermMsg, _pack_term_msg, _unpack_term_msg)
+register_struct("ba", AbaMessage, _pack_aba_msg, _unpack_aba_msg)
+register_struct("signmsg", SignMessage, _pack_sign_msg, _unpack_sign_msg)
+register_struct("decmsg", DecryptMessage, _pack_decrypt_msg, _unpack_decrypt_msg)
+register_struct("subsetmsg", SubsetMessage, _pack_subset_msg, _unpack_subset_msg)
+register_struct("hbmsg", HbMessage, _pack_hb_msg, _unpack_hb_msg)
+register_struct("dhbmsg", DhbMessage, _pack_dhb_msg, _unpack_dhb_msg)
+register_struct("sqmsg", SqMessage, _pack_sq_msg, _unpack_sq_msg)

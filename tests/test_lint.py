@@ -313,6 +313,65 @@ def test_unclassified_struct_tag_flagged():
 
 
 # ---------------------------------------------------------------------------
+# HBT006: socket reads honor the max-frame plumbing
+# ---------------------------------------------------------------------------
+
+HBT006_UNBOUNDED_BAD = """
+def read_all(sock):
+    return sock.recv(1 << 30)
+"""
+
+HBT006_NO_ARG_BAD = """
+def read_all(sock):
+    return sock.recv()
+"""
+
+HBT006_CHUNK_OK = """
+from hbbft_tpu.transport.framing import RECV_CHUNK
+
+def read_some(sock):
+    return sock.recv(RECV_CHUNK)
+"""
+
+HBT006_SMALL_LITERAL_OK = """
+def read_some(sock):
+    return sock.recv(4096)
+"""
+
+HBT006_ESCAPED_OK = """
+def drain_wake_pipe(pipe):
+    # lint: raw-recv (self-pipe, not peer input)
+    return pipe.recv(1 << 20)
+"""
+
+
+def test_unbounded_recv_flagged():
+    f = py_findings(HBT006_UNBOUNDED_BAD, path="hbbft_tpu/transport/transport.py")
+    assert "HBT006" in rules_of(f)
+    f = py_findings(HBT006_NO_ARG_BAD, path="hbbft_tpu/transport/transport.py")
+    assert "HBT006" in rules_of(f)
+
+
+def test_recv_chunk_and_small_literal_pass():
+    f = py_findings(HBT006_CHUNK_OK, path="hbbft_tpu/transport/transport.py")
+    assert "HBT006" not in rules_of(f)
+    f = py_findings(
+        HBT006_SMALL_LITERAL_OK, path="hbbft_tpu/transport/transport.py"
+    )
+    assert "HBT006" not in rules_of(f)
+
+
+def test_recv_escape_comment_passes():
+    f = py_findings(HBT006_ESCAPED_OK, path="hbbft_tpu/transport/transport.py")
+    assert "HBT006" not in rules_of(f)
+
+
+def test_recv_rule_scoped_to_package_tree():
+    f = py_findings(HBT006_UNBOUNDED_BAD, path="tests/test_transport.py")
+    assert "HBT006" not in rules_of(f)
+
+
+# ---------------------------------------------------------------------------
 # HBC001: C++ field resets (fixture structs + patched real source)
 # ---------------------------------------------------------------------------
 

@@ -45,6 +45,47 @@ def test_virtual_net_records_flush_metrics():
     assert net.metrics.timers["verify_flush"].count > 0
 
 
+def test_gauges_last_write_wins_and_merge():
+    a, b = Metrics(), Metrics()
+    a.gauge("depth", 3)
+    a.gauge("depth", 7)  # set semantics, not accumulate
+    assert a.gauges["depth"] == 7
+    b.gauge("depth", 1)
+    b.gauge("other", 2.5)
+    a.merge(b)
+    assert a.gauges == {"depth": 1, "other": 2.5}
+    assert "gauges:" in a.report()
+
+
+def test_to_json_roundtrips_through_json():
+    import json
+
+    m = Metrics()
+    m.count("c", 3)
+    m.gauge("g", 1.5)
+    with m.timer("t"):
+        pass
+    snap = json.loads(json.dumps(m.to_json()))
+    assert snap["counters"]["c"] == 3
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["timers"]["t"]["count"] == 1
+
+
+def test_prometheus_text_format():
+    m = Metrics()
+    m.count("transport.frames", 12)
+    m.gauge("transport.0->1.queue_frames", 4)
+    with m.timer("flush"):
+        pass
+    text = m.prometheus_text()
+    assert '# TYPE hbbft_count counter' in text
+    assert 'hbbft_count{name="transport.frames"} 12' in text
+    assert 'hbbft_gauge{name="transport.0->1.queue_frames"} 4' in text
+    assert 'hbbft_timer_seconds_count{name="flush"} 1' in text
+    assert text.endswith("\n")
+    assert Metrics().prometheus_text() == ""
+
+
 def test_epoch_tracker():
     t = EpochTracker()
     t.start((0, 0), 1.0)

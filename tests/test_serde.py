@@ -593,3 +593,69 @@ def test_depth_and_memo_boundaries_match_both_paths():
     except serde.EncodeError:
         deeper_ok = False
     assert not deeper_ok  # encoder refuses past the limit either way
+
+
+def test_transport_boundary_unpackers_reject_malformed():
+    """The live-wire message codecs (wire.py "transport-boundary types")
+    are stricter than the in-process handlers; pin each reject branch by
+    dumping a structurally-valid-but-semantically-bad object (frozen
+    dataclasses construct anything) and asserting loads() refuses it."""
+    import pytest
+
+    from hbbft_tpu.ops.merkle import Proof
+    from hbbft_tpu.protocols.binary_agreement import AbaMessage, TermMsg
+    from hbbft_tpu.protocols.bool_set import BoolSet
+    from hbbft_tpu.protocols.broadcast import EchoMsg, ReadyMsg, ValueMsg
+    from hbbft_tpu.protocols.dynamic_honey_badger import DhbMessage
+    from hbbft_tpu.protocols.honey_badger import DECRYPT, SUBSET, HbMessage
+    from hbbft_tpu.protocols.sbv_broadcast import BValMsg
+    from hbbft_tpu.protocols.sender_queue import SqMessage
+    from hbbft_tpu.protocols.subset import BC, SubsetMessage
+    from hbbft_tpu.utils import serde
+
+    good_proof = Proof(b"leaf", 0, (b"h" * 32,), b"r" * 32)
+    good_subset = SubsetMessage(1, BC, ValueMsg(good_proof))
+    good_hb = HbMessage(0, SUBSET, None, good_subset)
+
+    bad = [
+        ReadyMsg(b"short-root"),                      # root not 32 bytes
+        ReadyMsg("r" * 32),                           # root not bytes
+        EchoMsg(b"not-a-proof"),                      # proof wrong type
+        ValueMsg(None),
+        Proof(b"v", -1, (), b"r" * 32),               # negative index
+        Proof(b"v", 0, (b"short",), b"r" * 32),       # path hash not 32B
+        BValMsg(1),                                   # int, not bool
+        AbaMessage(-1, TermMsg(True)),                # negative round
+        AbaMessage(0, b"junk"),                       # content wrong type
+        SubsetMessage(1, "neither", TermMsg(True)),   # bad kind
+        SubsetMessage(1, BC, AbaMessage(0, TermMsg(True))),  # ba inner in bc
+        HbMessage(0, SUBSET, 3, good_subset),         # subset with proposer
+        HbMessage(0, DECRYPT, 3, good_subset),        # wrong decrypt inner
+        HbMessage(-1, SUBSET, None, good_subset),     # negative epoch
+        HbMessage(0, "nope", None, good_subset),      # bad kind
+        DhbMessage(-1, good_hb),                      # negative era
+        DhbMessage(0, good_subset),                   # inner not HbMessage
+        SqMessage("nope", 1),                         # unknown kind
+        SqMessage("epoch_started", (0,)),             # not a 2-tuple
+        SqMessage("epoch_started", (0, -1)),          # negative epoch
+        SqMessage("epoch_started", (0, True)),        # bool is not an epoch
+        SqMessage("algo", good_subset),               # not a Dhb/Hb message
+        SqMessage("join_plan", b"forged"),            # not a JoinPlan
+    ]
+    for obj in bad:
+        enc = serde.dumps(obj)
+        with pytest.raises(serde.DecodeError):
+            serde.loads(enc)
+        assert serde.try_loads(enc) is None
+
+    # BoolSet's constructor forbids mask 4, so hand-assemble the struct
+    # frame: STRUCT "bools" + fields tuple(1) + int 4.
+    raw = bytes(
+        [0x10, 5] + list(b"bools") + [0x06, 0, 0, 0, 1]
+        + [0x03, 0, 0, 0, 0, 1, 4]
+    )
+    with pytest.raises(serde.DecodeError):
+        serde.loads(raw)
+    # sanity: valid masks decode
+    assert serde.loads(serde.dumps(BoolSet.both())) == BoolSet.both()
+    assert serde.loads(serde.dumps(good_hb)) == good_hb

@@ -1,0 +1,472 @@
+"""TCP transport + cluster runtime (ISSUE 4 acceptance surface).
+
+Default-tier budget on the 1-core box: each cluster test drives an N=4
+localhost cluster for a handful of epochs — single-digit seconds apiece
+in practice, with generous wall caps so a loaded box does not flake
+(CLAUDE.md "transport test budgets").  The subprocess-mode test is
+``slow``.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+
+import pytest
+
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+from hbbft_tpu.transport import (
+    FaultInjector,
+    FrameDecoder,
+    FrameError,
+    KIND_MSG,
+    LinkFaults,
+    LocalCluster,
+    PartitionSpec,
+    decode_hello,
+    encode_frame,
+    encode_hello,
+)
+from hbbft_tpu.utils import serde
+
+EPOCH_TIMEOUT_S = 45  # wall cap per driven phase; typical is < 2 s
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_incremental():
+    payloads = [b"", b"x", b"hello world" * 100]
+    stream = b"".join(encode_frame(KIND_MSG, p) for p in payloads)
+    dec = FrameDecoder()
+    got = []
+    # feed byte-by-byte: the decoder must resynchronize on frame edges
+    for i in range(len(stream)):
+        dec.feed(stream[i : i + 1])
+        got.extend(dec.frames())
+    assert [p for _, p in got] == payloads
+    assert dec.buffered() == 0
+
+
+def test_frame_oversize_rejected_from_prefix_alone():
+    dec = FrameDecoder(max_frame_len=1024)
+    # the declared length alone must reject — no payload bytes needed
+    dec.feed((1 << 20).to_bytes(4, "big"))
+    with pytest.raises(FrameError):
+        dec.next_frame()
+    # poisoned decoder refuses further input
+    with pytest.raises(FrameError):
+        dec.feed(b"more")
+
+
+def test_frame_bad_kind_and_zero_length_rejected():
+    import zlib
+
+    dec = FrameDecoder()
+    # unknown kind 0x7f with a VALID crc: must die on the kind check,
+    # not the crc check
+    body = b"\x7f"
+    dec.feed(
+        (1).to_bytes(4, "big") + zlib.crc32(body).to_bytes(4, "big") + body
+    )
+    with pytest.raises(FrameError):
+        dec.next_frame()
+    dec2 = FrameDecoder()
+    dec2.feed((0).to_bytes(4, "big"))
+    with pytest.raises(FrameError):
+        dec2.next_frame()
+
+
+def test_frame_crc_rejects_payload_bit_flip():
+    """Channel corruption anywhere in the frame body dies at the framing
+    layer (connection-drop path), so the resume layer's clean-original
+    retransmission covers it; without the CRC a payload flip could parse
+    and be consumed+ACKed as the honest peer's message."""
+    frame = bytearray(encode_frame(KIND_MSG, b"hello world payload"))
+    frame[10] ^= 0x04  # flip a payload bit (body starts at offset 8)
+    dec = FrameDecoder()
+    dec.feed(bytes(frame))
+    with pytest.raises(FrameError, match="CRC"):
+        dec.next_frame()
+
+
+def test_encode_refuses_over_limit():
+    with pytest.raises(FrameError):
+        encode_frame(KIND_MSG, b"x" * 100, max_frame_len=50)
+
+
+def test_hello_validation():
+    frame = encode_hello(3, b"cluster-a")
+    dec = FrameDecoder()
+    dec.feed(frame)
+    kind, payload = dec.next_frame()
+    assert decode_hello(payload, b"cluster-a") == 3
+    with pytest.raises(FrameError):
+        decode_hello(payload, b"cluster-b")  # foreign cluster
+    with pytest.raises(FrameError):
+        decode_hello(b"\xff garbage", b"cluster-a")
+    with pytest.raises(FrameError):
+        # wrong version
+        decode_hello(serde.dumps((99, b"cluster-a", 3)), b"cluster-a")
+
+
+def test_framing_fuzz_parity_with_serde():
+    """Satellite: truncated/oversized/bit-flipped frames through the
+    decoder — no crash ever, and for frames that survive framing the
+    payload's accept/reject must match the pure-Python serde decoder
+    (the native scan path and limits stay in lockstep, extending the
+    tests/test_serde.py fuzz-equivalence pattern to the frame layer)."""
+    from hbbft_tpu.protocols.sender_queue import SqMessage
+
+    def pure_loads(data):
+        r = serde._Reader(data, None)
+        obj = serde._decode(r, 0)
+        if r.pos != len(r.data):
+            raise serde.DecodeError("trailing bytes")
+        return obj
+
+    msg = SqMessage.epoch_started((2, 7))
+    enc = serde.dumps(msg)
+    frame = encode_frame(KIND_MSG, enc)
+    rng = random.Random(1234)
+
+    def sweep(mutated: bytes):
+        dec = FrameDecoder(max_frame_len=1 << 16)
+        try:
+            dec.feed(mutated)
+            frames = dec.frames()
+        except FrameError:
+            return  # rejected at the frame layer: fine
+        for kind, payload in frames:
+            if kind != KIND_MSG:
+                continue
+            try:
+                got = serde.loads(payload)
+            except serde.DecodeError:
+                got = "ERR"
+            try:
+                want = pure_loads(payload)
+            except serde.DecodeError:
+                want = "ERR"
+            assert (got == "ERR") == (want == "ERR")
+            if want != "ERR":
+                assert got == want
+
+    for cut in range(len(frame)):
+        sweep(frame[:cut])
+    for _ in range(400):
+        i = rng.randrange(len(frame))
+        mutated = (
+            frame[:i]
+            + bytes([frame[i] ^ (1 << rng.randrange(8))])
+            + frame[i + 1 :]
+        )
+        sweep(mutated)
+    # oversized declared lengths at every byte of the prefix
+    for i in range(4):
+        mutated = bytearray(frame)
+        mutated[i] = 0xFF
+        sweep(bytes(mutated))
+
+
+# ---------------------------------------------------------------------------
+# cluster drivers
+# ---------------------------------------------------------------------------
+
+
+def drive(cluster, ids, target, timeout_s=EPOCH_TIMEOUT_S, tag="d"):
+    """LocalCluster.drive_to holds the pacing invariant; tests fail on
+    its TimeoutError."""
+    cluster.drive_to(ids, target, timeout_s=timeout_s, tag=tag)
+
+
+def batch_keys(cluster, nid, upto=None):
+    bs = cluster.batches(nid)
+    if upto is not None:
+        bs = bs[:upto]
+    return [(b.era, b.epoch, serde.dumps(b.contributions)) for b in bs]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: N=4 epochs, kill/restart, partition/heal
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_commits_three_epochs_byte_identical():
+    """N=4 localhost TCP cluster commits >= 3 HoneyBadger epochs with
+    byte-identical outputs across all correct nodes, well under 60 s."""
+    t0 = time.monotonic()
+    with LocalCluster(4, seed=42) as c:
+        drive(c, [0, 1, 2, 3], 3)
+        want = batch_keys(c, 0, upto=3)
+        for i in [1, 2, 3]:
+            assert batch_keys(c, i, upto=3) == want
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+        assert m.counters.get("cluster.bad_payload", 0) == 0
+        assert m.counters.get("transport.accepts", 0) >= 12  # full mesh
+    assert time.monotonic() - t0 < 60
+
+
+def test_cluster_kill_restart_continues_committing():
+    """f=1 over real sockets: killing one node mid-epoch does not stop
+    the other three; a restarted (state-wiped) node's transport comes
+    back and the cluster keeps committing byte-identically."""
+    with LocalCluster(4, seed=11) as c:
+        drive(c, [0, 1, 2, 3], 2)
+        c.kill(3)
+        base = len(c.batches(0))
+        drive(c, [0, 1, 2], base + 2)
+        c.restart(3)
+        drive(c, [0, 1, 2], len(c.batches(0)) + 2, tag="post")
+        want = batch_keys(c, 0, upto=4)
+        for i in [1, 2]:
+            assert batch_keys(c, i, upto=4) == want
+        # the reborn node is reachable again (its listener accepted
+        # fresh peer connections on the old port) — allow for the
+        # peers' dial-backoff cap before their next retry fires
+        def reborn_accepted(cl):
+            return (
+                sum(
+                    st["accepts"]
+                    for st in cl.nodes[3].transport.stats().values()
+                )
+                >= 1
+            )
+
+        assert c.wait(reborn_accepted, 15)
+        assert c.merged_metrics().counters.get("cluster.handler_errors", 0) == 0
+
+
+def test_cluster_partition_heals_and_continues():
+    """A seeded partition isolating one node: the majority side keeps
+    committing during the window; after heal the links carry frames
+    again and committing continues."""
+    inj = FaultInjector(seed=5)
+    with LocalCluster(4, seed=13, injector=inj) as c:
+        drive(c, [0, 1, 2, 3], 2)
+        inj.add_partition(
+            PartitionSpec(
+                (frozenset([0, 1, 2]), frozenset([3])), start_s=inj.elapsed()
+            )
+        )
+        base = len(c.batches(0))
+        drive(c, [0, 1, 2], base + 2, tag="part")
+        assert inj.stats.partitioned > 0  # the fault is logged
+        frames_to_3_before = sum(
+            c.nodes[i].transport.peer_stats[3].frames_out for i in [0, 1, 2]
+        )
+        inj.heal_all()
+        drive(c, [0, 1, 2], len(c.batches(0)) + 2, tag="heal")
+        frames_to_3_after = sum(
+            c.nodes[i].transport.peer_stats[3].frames_out for i in [0, 1, 2]
+        )
+        assert frames_to_3_after > frames_to_3_before  # links healed
+        want = batch_keys(c, 0, upto=4)
+        for i in [1, 2]:
+            assert batch_keys(c, i, upto=4) == want
+
+
+# ---------------------------------------------------------------------------
+# fault injection: corruption never crashes a node
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_frames_drop_connection_then_reconnect():
+    """Raw sockets attacking a node's listener: an unconfigured peer id
+    and an oversized frame each get the connection dropped (we observe
+    EOF) with the fault counted — the node stays alive, keeps accepting
+    its real peers, and keeps committing."""
+    with LocalCluster(4, seed=21) as c:
+        drive(c, [0, 1, 2, 3], 1)
+        addr = c.addr_map[0]
+        cid = c.cluster_id
+
+        def drain_to_eof(s):
+            s.settimeout(5)
+            while s.recv(64):
+                pass
+            s.close()
+
+        # unknown peer id: rejected at HELLO
+        s = socket.create_connection(addr, timeout=5)
+        s.sendall(encode_hello(99, cid))
+        drain_to_eof(s)
+
+        # known peer id, then an oversized declared length: rejected
+        # from the 4-byte prefix alone (no MSG frame is ever consumed,
+        # so the spoofed id cannot desync the real peer's resume ACKs)
+        s2 = socket.create_connection(addr, timeout=5)
+        s2.sendall(encode_hello(2, cid))
+        s2.sendall((1 << 30).to_bytes(4, "big") + b"\xde\xad")
+        drain_to_eof(s2)
+
+        def faults_counted(cl):
+            return (
+                cl.nodes[0].transport.metrics.counters.get(
+                    "transport.frame_errors", 0
+                )
+                >= 2
+            )
+
+        assert c.wait(faults_counted, 10)
+
+        # the node is still committing epochs with its REAL peers
+        drive(c, [0, 1, 2, 3], len(c.batches(0)) + 1, tag="after")
+        assert c.merged_metrics().counters.get("cluster.handler_errors", 0) == 0
+
+
+def test_wrong_type_payload_is_bad_payload_not_handler_error():
+    """A well-formed serde payload that is not an SqMessage is peer
+    garbage: counted as cluster.bad_payload and dropped, never fed to
+    the protocol (cluster.handler_errors stays the local-bug-only
+    signal the other tests pin to zero)."""
+    with LocalCluster(4, seed=61) as c:
+        node = c.nodes[0]
+        node.inbox.put(("msg", 1, serde.dumps(7)))
+        node.inbox.put(("msg", 1, serde.dumps((b"x", [1, 2]))))
+
+        def counted(cl):
+            return cl.nodes[0].metrics.counters.get("cluster.bad_payload", 0) >= 2
+
+        assert c.wait(counted, 10)
+        assert node.metrics.counters.get("cluster.handler_errors", 0) == 0
+        drive(c, [0, 1, 2, 3], 1)  # still live
+
+
+def test_random_link_corruption_cluster_survives():
+    """Byte corruption + duplication + delay on every link OUT of one
+    node: receivers' decoders reject, connections cycle (drop ->
+    reconnect), and the cluster keeps committing byte-identically —
+    f=1 covers a node whose outbound traffic is flaky.  (Sustained
+    corruption on ALL links is not a liveness scenario: frames lost
+    between connection drops are never retransmitted, by design — see
+    docs/TRANSPORT.md "loss model".)"""
+    flaky = LinkFaults(corrupt_p=0.05, dup_p=0.1, delay_p=0.2)
+    inj = FaultInjector(
+        seed=3, links={(3, 0): flaky, (3, 1): flaky, (3, 2): flaky}
+    )
+    with LocalCluster(4, seed=33, injector=inj) as c:
+        drive(c, [0, 1, 2], 3, timeout_s=60)
+        want = batch_keys(c, 0, upto=3)
+        for i in [1, 2]:
+            assert batch_keys(c, i, upto=3) == want
+        m = c.merged_metrics()
+        # corruption actually happened, was detected, and was survived.
+        # Detection surfaces at whichever layer the flipped bits land:
+        # header bytes -> frame_errors (connection dropped), payload
+        # bytes -> bad_payload (message dropped at the serde boundary).
+        assert inj.stats.corrupted > 0
+        detected = m.counters.get("transport.frame_errors", 0) + m.counters.get(
+            "cluster.bad_payload", 0
+        )
+        assert detected > 0
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+
+
+def test_backpressure_overflow_is_counted_not_fatal():
+    """A dead destination with a tiny queue cap: the sender drops and
+    counts instead of buffering without bound."""
+    with LocalCluster(4, seed=55, max_queue_frames=50) as c:
+        c.kill(3)
+        drive(c, [0, 1, 2], len(c.batches(0)) + 3, timeout_s=60)
+        m = c.merged_metrics()
+        assert m.counters.get("transport.queue_overflow", 0) > 0
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: sender-queue churn over real sockets
+# ---------------------------------------------------------------------------
+
+
+def test_sender_queue_churn_disconnect_reconnect_catches_up():
+    """A node that disconnects MID-EPOCH and reconnects catches up via
+    the sender-queue window machinery plus the transport's resume layer
+    (unacked frames retransmit on reconnect, docs/TRANSPORT.md): its
+    committed sequence has no holes and no duplicates, byte-identical
+    to the stable nodes'.  No quiescing — QHB churns empty epochs
+    continuously, so there IS no quiet moment to cut at; the resume
+    layer is what makes an arbitrary cut lossless for a live process."""
+    with LocalCluster(4, seed=7) as c:
+        drive(c, [0, 1, 2, 3], 2)
+        c.disconnect(3)
+        base = len(c.batches(0))
+        drive(c, [0, 1, 2], base + 3, tag="out")
+        stalled = len(c.batches(3))
+        assert stalled < len(c.batches(0))  # it really was cut off
+        c.reconnect(3)
+        target = len(c.batches(0))
+
+        def caught_up(cl):
+            return len(cl.batches(3)) >= target
+
+        # No new load during catch-up: the missed-epoch stream already
+        # sits in the peers' outbound queues and sender-queue outboxes;
+        # releasing it only needs the victim's own epoch announcements.
+        assert c.wait(caught_up, EPOCH_TIMEOUT_S), (len(c.batches(3)), target)
+        b0, b3 = batch_keys(c, 0), batch_keys(c, 3)
+        k = min(len(b0), len(b3))
+        assert b3[:k] == b0[:k]  # no lost outputs: identical prefix
+        keys = [(e, ep) for e, ep, _ in b3]
+        assert len(keys) == len(set(keys))  # no duplicate outputs
+        st = c.nodes[3].transport.stats()
+        assert sum(s["accepts"] for s in st.values()) >= 3  # peers re-dialed
+
+
+# ---------------------------------------------------------------------------
+# subprocess mode (flag-gated; slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_subprocess_cluster_commits_identically():
+    import json
+    import os
+    import subprocess
+    import sys
+
+    n, epochs, seed = 4, 2, 9
+    ports = []
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "hbbft_tpu.transport.cluster_worker",
+                "--node-id", str(i),
+                "--n", str(n),
+                "--seed", str(seed),
+                "--port", str(ports[i]),
+                "--peers", peers,
+                "--epochs", str(epochs),
+                "--timeout-s", "90",
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+            env=env,
+        )
+        for i in range(n)
+    ]
+    outs = [p.communicate(timeout=150)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    batch_lines = []
+    for out in outs:
+        lines = [json.loads(line) for line in out.splitlines() if line]
+        assert lines[-1]["done"] is True
+        batch_lines.append(lines[: epochs])
+    for i in range(1, n):
+        assert batch_lines[i] == batch_lines[0]

@@ -19,6 +19,11 @@ Rules (ids referenced from docs/INVARIANTS.md):
 * HBT005 — wire-deserialization and verify-batch surfaces must reach a
   subgroup check on point inputs (CLAUDE.md: "wire-sourced points MUST
   get subgroup checks somewhere").
+* HBT006 — every socket read in ``hbbft_tpu/`` honors the max-frame
+  plumbing: ``.recv(...)`` must pass the shared ``RECV_CHUNK`` bound (or
+  a literal <= 65536), so no syscall hands the process more untrusted
+  bytes than the :class:`FrameDecoder` cap logic admits per read
+  (docs/TRANSPORT.md; ``# lint: raw-recv`` escapes non-socket recv()s).
 
 All rules work on (virtual) repo-relative paths, so tests can feed
 fixture sources through :func:`lint_files` without touching disk.
@@ -34,6 +39,10 @@ from tools.lint import Finding
 
 SAFETY_COMMENT_RE = re.compile(r"#\s*safety:", re.IGNORECASE)
 NO_SUBGROUP_RE = re.compile(r"#\s*lint:\s*no-subgroup", re.IGNORECASE)
+RAW_RECV_RE = re.compile(r"#\s*lint:\s*raw-recv", re.IGNORECASE)
+
+#: recv() bound HBT006 accepts as a literal; matches framing.RECV_CHUNK.
+MAX_RECV_LITERAL = 1 << 16
 
 
 def _call_name(node: ast.expr) -> Optional[str]:
@@ -445,8 +454,17 @@ SUBGROUP_ENTRY_NAMES = {"g1_from_bytes", "g2_from_bytes", "verify_batch"}
 POINT_STRUCT_TAGS = {
     "ct", "sig", "pk", "comm", "bicomm", "change", "svote", "skg",
     "icontrib", "joinplan", "part", "ack",
+    # transport-boundary live-message tree (group elements ride in the
+    # share leaves; envelopes delegate via isinstance of nested types)
+    "sigshare", "decshare", "signmsg", "decmsg", "ba_coin", "ba",
+    "subsetmsg", "hbmsg", "dhbmsg", "sqmsg",
 }
-NONPOINT_STRUCT_TAGS = {"encsched"}
+NONPOINT_STRUCT_TAGS = {
+    "encsched",
+    # transport-boundary types with no group elements anywhere below
+    "proof", "bc_value", "bc_echo", "bc_ready", "bc_echohash",
+    "bc_candecode", "bools", "ba_bval", "ba_aux", "ba_conf", "ba_term",
+}
 
 # Types whose isinstance check counts as delegation: the value was
 # decoded by its own registered unpacker (serde core dispatches nested
@@ -455,6 +473,8 @@ _POINT_TYPE_NAMES = {
     "Ciphertext", "Signature", "PublicKey", "PublicKeySet", "Commitment",
     "BivarCommitment", "Part", "Ack", "Change", "SignedVote",
     "SignedKeyGenMsg",
+    "SignatureShare", "DecryptionShare", "SignMessage", "DecryptMessage",
+    "CoinMsg", "AbaMessage", "SubsetMessage", "HbMessage", "DhbMessage",
 }
 
 _WIRE_MODULES = ("wire.py",)
@@ -669,6 +689,65 @@ def rule_subgroup_checks(files: Dict[str, ast.AST], sources: Dict[str, str]) -> 
 
 
 # ---------------------------------------------------------------------------
+# HBT006: socket reads honor the max-frame plumbing
+# ---------------------------------------------------------------------------
+
+
+def rule_bounded_recv(path: str, src: str, tree: ast.AST) -> List[Finding]:
+    """Every ``.recv(...)`` call in the product tree must be bounded by
+    the shared ``RECV_CHUNK`` constant (or an int literal within it).
+
+    The frame decoder enforces ``max_frame_len`` per frame, but the
+    *syscall* is the first place untrusted bytes enter the process — an
+    unbounded or over-large recv would let a peer make one event-loop
+    iteration buffer arbitrary data before any frame check runs.  The
+    escape comment ``# lint: raw-recv`` exists for recv()s that are not
+    socket reads of untrusted peers.
+    """
+    if not path.replace("\\", "/").startswith("hbbft_tpu/"):
+        return []
+    lines = src.splitlines()
+    escapes = {
+        i for i, line in enumerate(lines, 1) if RAW_RECV_RE.search(line)
+    }
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "recv"
+        ):
+            continue
+        if any(ln in escapes for ln in range(node.lineno - 2, node.lineno + 1)):
+            continue
+        ok = False
+        if len(node.args) == 1 and not node.keywords:
+            a = node.args[0]
+            if isinstance(a, ast.Name) and a.id == "RECV_CHUNK":
+                ok = True
+            elif (
+                isinstance(a, ast.Constant)
+                and type(a.value) is int
+                and 0 < a.value <= MAX_RECV_LITERAL
+            ):
+                ok = True
+        if not ok:
+            findings.append(
+                Finding(
+                    "HBT006",
+                    path,
+                    node.lineno,
+                    "unbounded/over-large socket read: pass RECV_CHUNK (or"
+                    f" a literal <= {MAX_RECV_LITERAL}) so one syscall never"
+                    " buffers more untrusted bytes than the frame decoder"
+                    " admits; '# lint: raw-recv' escapes non-socket recv()s"
+                    " (docs/TRANSPORT.md read-path rules)",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -677,6 +756,7 @@ _PER_FILE_RULES = (
     rule_step_reuse,
     rule_jit_interpret_pallas,
     rule_scan_accumulator,
+    rule_bounded_recv,
 )
 
 
