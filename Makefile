@@ -23,9 +23,12 @@ test-protocol:
 		--ignore=tests/test_tpu_crypto.py --ignore=tests/test_jax_ops.py
 
 # N=4 TCP cluster smoke: 3 epochs over localhost sockets, kill/restart
-# and partition drills included (the ISSUE-4 acceptance surface).
+# and partition drills included (the ISSUE-4 acceptance surface), plus
+# the native-node tier (ISSUE-5: engine-per-node oracle equivalence,
+# drills re-run native, wire-codec fuzz parity — needs g++, skips
+# cleanly without one).
 cluster-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport.py \
-		-q -m 'not slow'
+		tests/test_transport_native.py -q -m 'not slow'
 
 .PHONY: lint asan ubsan tsan test-protocol cluster-smoke

@@ -10,13 +10,26 @@ One JSON line per N (like config1..5):
     BENCH_TCP_NS="4,8,16" BENCH_TCP_EPOCHS=5 python \
         benchmarks/config6_tcp_cluster.py
 
+Round 9 A/B: ``BENCH_TCP_IMPL=native`` runs one C++ engine per node
+(LocalCluster ``node_impl`` — the message-boundary wire API) against
+the default ``python`` protocol-thread oracle.  Both arms pre-submit a
+deterministic workload before start, so a native arm at seed s commits
+byte-identical batches to the Python arm at seed s and the JSON's
+``batches_sha`` can be compared across arms directly (the docs/
+TRANSPORT.md oracle-mode recipe).  ``BENCH_TCP_DRIVE=paced`` restores
+the round-8 wall-clock-paced feeder (throughput-trajectory continuity;
+cross-arm digests are NOT comparable in that mode — pacing races).
+
 Env: BENCH_TCP_NS (comma list, default "4,8,16"), BENCH_TCP_EPOCHS
 (target epochs per N, default 5), BENCH_TCP_DEADLINE_S per N (default
-300), BENCH_TCP_METRICS=1 to embed the merged metrics snapshot.
+300), BENCH_TCP_IMPL (python|native, default python), BENCH_TCP_DRIVE
+(presubmit|paced, default presubmit), BENCH_TCP_SEED (default 0),
+BENCH_TCP_METRICS=1 to embed the merged metrics snapshot.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import sys
@@ -29,31 +42,74 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # environment as the caller set it (CLAUDE.md bypass applies if jax
 # ends up imported transitively).
 
+from hbbft_tpu.protocols.queueing_honey_badger import Input  # noqa: E402
 from hbbft_tpu.transport import LocalCluster  # noqa: E402
+from hbbft_tpu.utils import serde  # noqa: E402
 
 
-def run_n(n: int, epochs: int, deadline_s: float) -> dict:
+def preload_engine_serde() -> bool:
+    """Load the engine lib (build if needed) so ``serde.loads`` takes
+    the C token-scan fast path even with Python nodes — round 8 ran
+    this bench engine-free, paying the recursive decoder on every
+    frame.  Returns whether the native scan is actually live."""
+    try:
+        from hbbft_tpu import native_engine
+
+        if native_engine.get_lib() is None:
+            return False
+    except Exception:
+        return False
+    return serde._native_scan(serde.dumps(0)) is not None
+
+
+def run_n(
+    n: int, epochs: int, deadline_s: float, impl: str, drive: str, seed: int
+) -> dict:
     t0 = time.perf_counter()
-    cluster = LocalCluster(n, seed=0, batch_size=8)
+    cluster = LocalCluster(n, seed=seed, batch_size=8, node_impl=impl)
     setup_s = time.perf_counter() - t0
     rec = {
         "config": "config6_tcp_cluster",
         "nodes": n,
         "suite": "scalar",
         "transport": "tcp-localhost",
+        "node_impl": impl,
+        "drive": drive,
+        "seed": seed,
+        "serde_native": serde._native_scan(serde.dumps(0)) is not None,
         "threads_per_node": 2,
         "target_epochs": epochs,
         "setup_s": round(setup_s, 3),
     }
+    if drive == "presubmit":
+        # Deterministic workload BEFORE start: every node sees the
+        # identical txn queue in every arm, so the first `epochs`
+        # batches are byte-identical across node_impls at one seed.
+        for k in range(epochs + 4):
+            for i in range(n):
+                cluster.submit(i, Input.user(f"b-{k}-{i}"))
     t0 = time.perf_counter()
     try:
         cluster.start()
         try:
-            cluster.drive_to(range(n), epochs, timeout_s=deadline_s)
+            if drive == "presubmit":
+                ok = cluster.wait(
+                    lambda c: all(
+                        len(c.batches(i)) >= epochs for i in range(n)
+                    ),
+                    deadline_s,
+                )
+                if not ok:
+                    raise TimeoutError
+            else:
+                cluster.drive_to(range(n), epochs, timeout_s=deadline_s)
         except TimeoutError:
             pass  # report whatever committed within the deadline
         wall = time.perf_counter() - t0
         committed = min(len(cluster.batches(i)) for i in range(n))
+        digest = hashlib.sha256()
+        for b in cluster.batches(0)[:epochs]:
+            digest.update(serde.dumps((b.era, b.epoch, b.contributions)))
         m = cluster.merged_metrics()
         frames = sum(
             st["frames_out"]
@@ -76,6 +132,7 @@ def run_n(n: int, epochs: int, deadline_s: float) -> dict:
                 ),
                 "frames_sent": frames,
                 "wire_mb": round(wire_bytes / 1e6, 2),
+                "batches_sha": digest.hexdigest()[:16],
                 "protocol_faults": m.counters.get("cluster.protocol_faults", 0),
                 "handler_errors": m.counters.get("cluster.handler_errors", 0),
                 "complete": committed >= epochs,
@@ -92,8 +149,12 @@ def main() -> None:
     ns = [int(x) for x in os.environ.get("BENCH_TCP_NS", "4,8,16").split(",")]
     epochs = int(os.environ.get("BENCH_TCP_EPOCHS", "5"))
     deadline = float(os.environ.get("BENCH_TCP_DEADLINE_S", "300"))
+    impl = os.environ.get("BENCH_TCP_IMPL", "python")
+    drive = os.environ.get("BENCH_TCP_DRIVE", "presubmit")
+    seed = int(os.environ.get("BENCH_TCP_SEED", "0"))
+    preload_engine_serde()
     for n in ns:
-        print(json.dumps(run_n(n, epochs, deadline)), flush=True)
+        print(json.dumps(run_n(n, epochs, deadline, impl, drive, seed)), flush=True)
 
 
 if __name__ == "__main__":

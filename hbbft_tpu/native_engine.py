@@ -296,6 +296,26 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
         cp, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
         ctypes.c_int64, ctypes.c_uint64,
     ]
+    # cluster (one-engine-per-node) mode + wire codec (round 9): the
+    # message-boundary API — batch frame ingress, epoch-gated egress
+    # drain, and the decode/roundtrip test surface.
+    lib.hbe_set_local.restype = None
+    lib.hbe_set_local.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.hbe_node_ingest_frames.restype = ctypes.c_int64
+    lib.hbe_node_ingest_frames.argtypes = [
+        ctypes.c_void_p, i32p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int32, cp,
+    ]
+    lib.hbe_node_egress_bytes.restype = ctypes.c_uint64
+    lib.hbe_node_egress_bytes.argtypes = [ctypes.c_void_p]
+    lib.hbe_node_egress_drain.restype = ctypes.c_int64
+    lib.hbe_node_egress_drain.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.hbe_node_stat.restype = ctypes.c_uint64
+    lib.hbe_node_stat.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.hbe_wire_classify.restype = ctypes.c_int32
+    lib.hbe_wire_classify.argtypes = [cp, ctypes.c_uint64]
+    lib.hbe_wire_roundtrip.restype = ctypes.c_int64
+    lib.hbe_wire_roundtrip.argtypes = [cp, ctypes.c_uint64, u8p, ctypes.c_uint64]
     lib.hbe_dkg_ack_check.restype = ctypes.c_int32
     lib.hbe_dkg_ack_check.argtypes = [
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, cp, cp, cp, cp, u8p,
@@ -534,7 +554,174 @@ class _NativeNode:
         self.contrib_cache: Dict[tuple, Any] = {}
 
 
-class NativeQhbNet:
+class _EngineNetBase:
+    """Shared engine-callback core: everything a Python runtime needs to
+    host engine batch events, whether it drives a whole simulated
+    network (:class:`NativeQhbNet`) or one cluster node over real
+    sockets (:class:`NativeNodeEngine`).
+
+    Subclass contract — attributes the callbacks read: ``lib``,
+    ``handle``, ``nodes`` (engine id -> :class:`_NativeNode`),
+    ``_suite``, ``_decode_cache`` / ``_slot_cache`` (shared
+    committed-payload decode caches), ``_cb_error``.  The decode-cache
+    purity rules documented on :class:`NativeQhbNet` apply to every
+    subclass.
+    """
+
+    lib: Any
+    handle: Any
+    nodes: Dict[int, "_NativeNode"]
+
+    # -- engine callbacks ----------------------------------------------
+    def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
+        # Committed payloads for a (era, epoch, proposer) slot are
+        # byte-identical across every node (Subset agreement — the
+        # engine's equivalence tests pin this), so after the first node
+        # decodes a slot, later nodes skip both the payload copy and
+        # the content-keyed lookup (DKG payloads are hundreds of KB).
+        slot = (era, epoch, proposer, length)
+        hit = self._slot_cache.get(slot)
+        if hit is not None:
+            if hit is _DECODE_FAILED:
+                return 0
+            self.nodes[node].contrib_cache[(era, epoch, proposer)] = hit
+            return 1
+        # ctypes.string_at = one memcpy; pointer slicing (data[:length])
+        # is per-element and cost ~12 ms on DKG-sized (~100 KB) payloads.
+        payload = ctypes.string_at(data, length) if length else b""
+        if payload in self._decode_cache:
+            obj = self._decode_cache[payload]
+            if obj is _DECODE_FAILED:
+                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
+                return 0
+        else:
+            try:
+                obj = serde.loads(payload, suite=self._suite)
+            except serde.DecodeError:
+                _cache_put(self._decode_cache, payload, _DECODE_FAILED)
+                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
+                return 0
+            _cache_put(self._decode_cache, payload, obj)
+        _cache_put(self._slot_cache, slot, obj)
+        self.nodes[node].contrib_cache[(era, epoch, proposer)] = obj
+        return 1
+
+    def _on_batch(self, node, era, epoch) -> None:
+        nd = self.nodes[node]
+        lib = self.lib
+        size = lib.hbe_batch_size(self.handle)
+        contribs = []
+        for i in range(size):
+            proposer = lib.hbe_batch_proposer(self.handle, i)
+            obj = nd.contrib_cache.pop((era, epoch, proposer), None)
+            contribs.append((proposer, obj))
+        batch = Batch(epoch, tuple(contribs))
+        dhb: NativeDhb = nd.qhb.dhb  # type: ignore[assignment]
+        dhb._rng = nd.rng
+        # Batch-digest fast path: hand the whole batch's DKG private
+        # checks to ONE native call before the per-message processing
+        # walks it (the round-5 continuation-tail lever).  Per-item
+        # misses fall back inside handle_part/handle_ack; a nested
+        # batch event (a proposal fired from inside _process_batch)
+        # clears the outer digests early, which only costs speed.
+        skg = self._predigest_dkg(dhb, batch)
+        try:
+            step = dhb._process_batch(batch)
+        finally:
+            if skg is not None:
+                skg.clear_predigest()
+        step = nd.qhb._absorb(step, nd.rng)
+        nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
+
+    @staticmethod
+    def _predigest_dkg(dhb: "NativeDhb", batch: Batch) -> Any:
+        """Collect the batch's in-era key-gen messages and batch their
+        private checks into the node's SyncKeyGen (no-op without a DKG
+        in flight).  Returns the SyncKeyGen whose digests must be
+        cleared after the batch, or None."""
+        state = dhb._key_gen
+        if state is None or state.key_gen is None:
+            return None
+        skg = state.key_gen
+        msgs = []
+        for _, contrib in batch.contributions:
+            if not isinstance(contrib, InternalContrib):
+                continue
+            for kg in contrib.key_gen_messages:
+                if isinstance(kg, SignedKeyGenMsg) and kg.era == dhb._era:
+                    msgs.append((kg.sender, kg.payload))
+        if msgs:
+            try:
+                skg.predigest_batch(msgs)
+            except Exception:
+                # Digesting is an optimization only: any failure leaves
+                # the per-item paths to re-derive every verdict.
+                skg.clear_predigest()
+        return skg
+
+    # Engine MsgType names for the typed delivery profiling slots 0..10
+    # (native/engine.cpp enum MsgType order).
+    MSG_TYPE_NAMES = (
+        "VALUE", "ECHO", "READY", "ECHO_HASH", "CAN_DECODE",
+        "BVAL", "AUX", "CONF", "COIN", "TERM", "DECRYPT",
+    )
+
+    def prof_stats(self) -> Dict[str, Dict[str, int]]:
+        """Delivery profiling counters: per-message-type cycles/counts
+        (slots 0..10) plus the claimed literal slots by registry name
+        (tools/lint/slot_registry.py).  Under the deferred RLC cadence
+        the engine folds flush-side continuation cycles back into the
+        COIN/DECRYPT typed slots, so ``cycles/count`` stays an honest
+        cyc/delivery across the HBBFT_TPU_COIN_RLC A/B."""
+        lib, h = self.lib, self.handle
+        out: Dict[str, Dict[str, int]] = {}
+        for i, name in enumerate(self.MSG_TYPE_NAMES):
+            out[name] = {
+                "cycles": int(lib.hbe_prof_cycles(h, i)),
+                "count": int(lib.hbe_prof_count(h, i)),
+            }
+        for slot, name in (
+            (11, "rlc_groups"),
+            (12, "batch_cb"),
+            (13, "epoch_advance"),
+            (14, "pool_flush"),
+            (15, "contrib_cb"),
+        ):
+            out[name] = {
+                "cycles": int(lib.hbe_prof_cycles(h, slot)),
+                "count": int(lib.hbe_prof_count(h, slot)),
+            }
+        return out
+
+    def _raise_cb_error(self) -> None:
+        if self._cb_error is not None:
+            exc, self._cb_error = self._cb_error, None
+            raise RuntimeError("engine crypto callback failed") from exc
+
+    def faults(self, nid: int) -> List[tuple]:
+        out = []
+        for i in range(self.lib.hbe_fault_count(self.handle, nid)):
+            out.append(
+                (
+                    self.lib.hbe_fault_subject(self.handle, nid, i),
+                    self.lib.hbe_fault_kind(self.handle, nid, i).decode(),
+                )
+            )
+        return out
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.hbe_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeQhbNet(_EngineNetBase):
     """Engine-backed QueueingHoneyBadger network (NetBuilder-compatible
     key generation and rng seeding, so runs are comparable to the
     Python VirtualNet at the same seed).
@@ -768,93 +955,6 @@ class NativeQhbNet:
                     lib.hbe_set_tampered(self.handle, i, 1)
                 else:
                     lib.hbe_set_silent(self.handle, i, 1)
-
-    # -- engine callbacks ----------------------------------------------
-    def _on_contrib(self, node, era, epoch, proposer, data, length) -> int:
-        # Committed payloads for a (era, epoch, proposer) slot are
-        # byte-identical across every node (Subset agreement — the
-        # engine's equivalence tests pin this), so after the first node
-        # decodes a slot, later nodes skip both the payload copy and
-        # the content-keyed lookup (DKG payloads are hundreds of KB).
-        slot = (era, epoch, proposer, length)
-        hit = self._slot_cache.get(slot)
-        if hit is not None:
-            if hit is _DECODE_FAILED:
-                return 0
-            self.nodes[node].contrib_cache[(era, epoch, proposer)] = hit
-            return 1
-        # ctypes.string_at = one memcpy; pointer slicing (data[:length])
-        # is per-element and cost ~12 ms on DKG-sized (~100 KB) payloads.
-        payload = ctypes.string_at(data, length) if length else b""
-        if payload in self._decode_cache:
-            obj = self._decode_cache[payload]
-            if obj is _DECODE_FAILED:
-                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
-                return 0
-        else:
-            try:
-                obj = serde.loads(payload, suite=self._suite)
-            except serde.DecodeError:
-                _cache_put(self._decode_cache, payload, _DECODE_FAILED)
-                _cache_put(self._slot_cache, slot, _DECODE_FAILED)
-                return 0
-            _cache_put(self._decode_cache, payload, obj)
-        _cache_put(self._slot_cache, slot, obj)
-        self.nodes[node].contrib_cache[(era, epoch, proposer)] = obj
-        return 1
-
-    def _on_batch(self, node, era, epoch) -> None:
-        nd = self.nodes[node]
-        lib = self.lib
-        size = lib.hbe_batch_size(self.handle)
-        contribs = []
-        for i in range(size):
-            proposer = lib.hbe_batch_proposer(self.handle, i)
-            obj = nd.contrib_cache.pop((era, epoch, proposer), None)
-            contribs.append((proposer, obj))
-        batch = Batch(epoch, tuple(contribs))
-        dhb: NativeDhb = nd.qhb.dhb  # type: ignore[assignment]
-        dhb._rng = nd.rng
-        # Batch-digest fast path: hand the whole batch's DKG private
-        # checks to ONE native call before the per-message processing
-        # walks it (the round-5 continuation-tail lever).  Per-item
-        # misses fall back inside handle_part/handle_ack; a nested
-        # batch event (a proposal fired from inside _process_batch)
-        # clears the outer digests early, which only costs speed.
-        skg = self._predigest_dkg(dhb, batch)
-        try:
-            step = dhb._process_batch(batch)
-        finally:
-            if skg is not None:
-                skg.clear_predigest()
-        step = nd.qhb._absorb(step, nd.rng)
-        nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
-
-    @staticmethod
-    def _predigest_dkg(dhb: "NativeDhb", batch: Batch) -> Any:
-        """Collect the batch's in-era key-gen messages and batch their
-        private checks into the node's SyncKeyGen (no-op without a DKG
-        in flight).  Returns the SyncKeyGen whose digests must be
-        cleared after the batch, or None."""
-        state = dhb._key_gen
-        if state is None or state.key_gen is None:
-            return None
-        skg = state.key_gen
-        msgs = []
-        for _, contrib in batch.contributions:
-            if not isinstance(contrib, InternalContrib):
-                continue
-            for kg in contrib.key_gen_messages:
-                if isinstance(kg, SignedKeyGenMsg) and kg.era == dhb._era:
-                    msgs.append((kg.sender, kg.payload))
-        if msgs:
-            try:
-                skg.predigest_batch(msgs)
-            except Exception:
-                # Digesting is an optimization only: any failure leaves
-                # the per-item paths to re-derive every verdict.
-                skg.clear_predigest()
-        return skg
 
     # -- external-crypto callbacks -------------------------------------
     #
@@ -1176,45 +1276,6 @@ class NativeQhbNet:
     def pending_verifies(self) -> int:
         return int(self.lib.hbe_pending_verifies(self.handle))
 
-    # Engine MsgType names for the typed delivery profiling slots 0..10
-    # (native/engine.cpp enum MsgType order).
-    MSG_TYPE_NAMES = (
-        "VALUE", "ECHO", "READY", "ECHO_HASH", "CAN_DECODE",
-        "BVAL", "AUX", "CONF", "COIN", "TERM", "DECRYPT",
-    )
-
-    def prof_stats(self) -> Dict[str, Dict[str, int]]:
-        """Delivery profiling counters: per-message-type cycles/counts
-        (slots 0..10) plus the claimed literal slots by registry name
-        (tools/lint/slot_registry.py).  Under the deferred RLC cadence
-        the engine folds flush-side continuation cycles back into the
-        COIN/DECRYPT typed slots, so ``cycles/count`` stays an honest
-        cyc/delivery across the HBBFT_TPU_COIN_RLC A/B."""
-        lib, h = self.lib, self.handle
-        out: Dict[str, Dict[str, int]] = {}
-        for i, name in enumerate(self.MSG_TYPE_NAMES):
-            out[name] = {
-                "cycles": int(lib.hbe_prof_cycles(h, i)),
-                "count": int(lib.hbe_prof_count(h, i)),
-            }
-        for slot, name in (
-            (11, "rlc_groups"),
-            (12, "batch_cb"),
-            (13, "epoch_advance"),
-            (14, "pool_flush"),
-            (15, "contrib_cb"),
-        ):
-            out[name] = {
-                "cycles": int(lib.hbe_prof_cycles(h, slot)),
-                "count": int(lib.hbe_prof_count(h, slot)),
-            }
-        return out
-
-    def _raise_cb_error(self) -> None:
-        if self._cb_error is not None:
-            exc, self._cb_error = self._cb_error, None
-            raise RuntimeError("engine crypto callback failed") from exc
-
     def run_until(self, pred: Callable[["NativeQhbNet"], bool],
                   chunk: int = 50_000, max_total: int = 1 << 40) -> None:
         total = 0
@@ -1230,24 +1291,163 @@ class NativeQhbNet:
     def delivered(self) -> int:
         return int(self.lib.hbe_delivered(self.handle))
 
-    def faults(self, nid: int) -> List[tuple]:
-        out = []
-        for i in range(self.lib.hbe_fault_count(self.handle, nid)):
-            out.append(
-                (
-                    self.lib.hbe_fault_subject(self.handle, nid, i),
-                    self.lib.hbe_fault_kind(self.handle, nid, i).decode(),
-                )
+
+class NativeNodeEngine(_EngineNetBase):
+    """ONE cluster node's engine: the message-boundary runtime behind
+    ``LocalCluster(node_impl="native")`` (round 9).
+
+    Where :class:`NativeQhbNet` simulates all N nodes behind one
+    internal queue, this engine runs in CLUSTER mode
+    (``hbe_set_local``): only ``node_id`` is initialized and driven;
+    every emission toward another id is serde-encoded in C (byte-
+    identical to ``serde.dumps(SqMessage.algo(...))`` — pinned by the
+    ``hbe_wire_roundtrip`` tests) and epoch-gated per peer with
+    SenderQueue's admit rules, and ingress frames are decoded + handled
+    natively in one ctypes call per read burst
+    (``hbe_node_ingest_frames``).  The per-BATCH layers are the same
+    reused Python stack as everywhere else: ``QueueingHoneyBadger``
+    over :class:`NativeDhb`, fed through the shared batch callbacks.
+
+    Scalar suite only (the cluster harness' protocol-plane suite);
+    ``flush_every`` is pinned to 1 — the byte-identical eager cadence —
+    so committed batches match the Python-node oracle exactly.
+
+    Threading: NOT thread-safe.  One owner thread makes every call
+    (ingest / handle_input / run / drain_egress); the transport thread
+    only ever touches the inbox queue in front of it
+    (transport/native_node.py).
+    """
+
+    #: SenderQueue max_future_epochs mirror (the egress send gate).
+    SQ_WINDOW = 3
+
+    #: hbe_node_stat slot names (engine ClStat order).
+    STAT_NAMES = (
+        "handled", "bad_payload", "ignored", "dropped_stale",
+        "held", "released", "sent", "announces",
+    )
+
+    def __init__(
+        self,
+        node_id: int,
+        netinfo: NetworkInfo,
+        seed: int = 0,
+        batch_size: int = 8,
+        session_id: bytes = b"tcp-cluster",
+        encryption_schedule: EncryptionSchedule = EncryptionSchedule.always(),
+        subset_handling: str = "incremental",
+        suite: Optional[Suite] = None,
+        rlc: Optional[bool] = None,
+    ) -> None:
+        n = len(netinfo.all_ids)
+        lib = get_lib(_words_for(n))
+        if lib is None:
+            raise RuntimeError("native engine unavailable (no compiler?)")
+        suite = suite if suite is not None else ScalarSuite()
+        if not isinstance(suite, ScalarSuite):
+            raise ValueError(
+                "NativeNodeEngine runs the scalar internal-crypto mode "
+                "only (the cluster protocol-plane suite)"
             )
-        return out
+        self.lib = lib
+        self.n = n
+        self.f = netinfo.num_faulty
+        self.ext = False
+        self.node_id = node_id
+        self._suite = suite
+        self._cb_error: Optional[BaseException] = None
+        self._decode_cache: Dict[bytes, Any] = {}
+        self._slot_cache: Dict[tuple, Any] = {}
+        self.handle = lib.hbe_create(n, self.f)
+        assert self.handle
+        if rlc is not None:
+            lib.hbe_set_rlc(self.handle, 1 if rlc else 0)
+        lib.hbe_set_local(self.handle, node_id, self.SQ_WINDOW)
+        # keep callback objects alive for the engine's lifetime
+        self._batch_cb = _BATCH_CB(self._on_batch)
+        self._contrib_cb = _CONTRIB_CB(self._on_contrib)
+        lib.hbe_set_callbacks(self.handle, self._batch_cb, self._contrib_cb)
+        # Same rng ritual as ClusterNode / NativeQhbNet, so a native
+        # cluster at seed s proposes the exact contribution stream of
+        # the Python-node cluster at seed s (the cross-arm byte-identity
+        # contract, tests/test_transport_native.py).
+        rng = random.Random((seed << 16) ^ (node_id + 1))
+        dhb = NativeDhb(
+            self, node_id, netinfo,
+            session_id=session_id,
+            encryption_schedule=encryption_schedule,
+            subset_handling=subset_handling,
+        )
+        qhb = QueueingHoneyBadger(
+            netinfo, _NullSink(), batch_size=batch_size,
+            session_id=session_id, dhb=dhb,
+        )
+        self.nodes = {node_id: _NativeNode(node_id, qhb, rng)}
 
-    def close(self) -> None:
-        if self.handle:
-            self.lib.hbe_destroy(self.handle)
-            self.handle = None
+    # -- driving (owner thread only) -----------------------------------
+    def handle_input(self, input: Any) -> None:
+        """Submit one local input (txn or vote) to the QHB stack; any
+        resulting proposal lands in the egress buffer."""
+        nd = self.nodes[self.node_id]
+        step = nd.qhb.handle_input(input, nd.rng)
+        nd.outputs.extend(o for o in step.output if isinstance(o, DhbBatch))
+        self._raise_cb_error()
 
-    def __del__(self) -> None:  # pragma: no cover
-        try:
-            self.close()
-        except Exception:
-            pass
+    def ingest(self, senders: List[int], payloads: List[bytes]) -> int:
+        """Decode + enqueue one batch of MSG-frame payloads in a single
+        ctypes call; returns the number of consumable frames (the
+        cluster.msgs_handled mirror).  Follow with :meth:`run`."""
+        k = len(payloads)
+        if k == 0:
+            return 0
+        offs = (ctypes.c_uint64 * (k + 1))()
+        pos = 0
+        for i, p in enumerate(payloads):
+            offs[i] = pos
+            pos += len(p)
+        offs[k] = pos
+        handled = int(
+            self.lib.hbe_node_ingest_frames(
+                self.handle,
+                (ctypes.c_int32 * k)(*senders),
+                offs, k, b"".join(payloads),
+            )
+        )
+        self._raise_cb_error()
+        return handled
+
+    def run(self, max_deliveries: int = 1 << 62) -> int:
+        """Drain the local delivery queue (returns when it is empty)."""
+        done = int(self.lib.hbe_run(self.handle, max_deliveries))
+        self._raise_cb_error()
+        return done
+
+    def drain_egress(self, send: Callable[[int, bytes], None]) -> int:
+        """Hand every pending egress frame to ``send(dest, payload)``;
+        returns the frame count.  One C call moves the whole batch."""
+        lib = self.lib
+        size = int(lib.hbe_node_egress_bytes(self.handle))
+        if not size:
+            return 0
+        buf = (ctypes.c_uint8 * size)()
+        nrec = int(lib.hbe_node_egress_drain(self.handle, buf, size))
+        if nrec <= 0:
+            return 0
+        data = memoryview(buf)  # zero-copy view; payload slices copy once
+        pos = 0
+        for _ in range(nrec):
+            dest = int.from_bytes(data[pos:pos + 4], "little")
+            ln = int.from_bytes(data[pos + 4:pos + 8], "little")
+            send(dest, bytes(data[pos + 8:pos + 8 + ln]))
+            pos += 8 + ln
+        return nrec
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            name: int(self.lib.hbe_node_stat(self.handle, i))
+            for i, name in enumerate(self.STAT_NAMES)
+        }
+
+    @property
+    def outputs(self) -> List[DhbBatch]:
+        return self.nodes[self.node_id].outputs

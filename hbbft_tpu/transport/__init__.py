@@ -9,6 +9,7 @@ byte-level fault injector (:mod:`.faults`).  See docs/TRANSPORT.md.
 """
 
 from hbbft_tpu.transport.cluster import ClusterNode, LocalCluster
+from hbbft_tpu.transport.native_node import NativeClusterNode
 from hbbft_tpu.transport.faults import (
     FaultInjector,
     LinkFaults,

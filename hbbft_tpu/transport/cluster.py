@@ -247,6 +247,7 @@ class LocalCluster:
         injector: Any = None,
         max_frame_len: Optional[int] = None,
         max_queue_frames: int = 20_000,
+        node_impl: Any = "python",
     ) -> None:
         self.n = n
         self.seed = seed
@@ -256,6 +257,27 @@ class LocalCluster:
         self.cluster_id = cluster_id
         self.injector = injector
         self.metrics = Metrics()
+        # node_impl (round 9): "python" (the oracle ClusterNode above),
+        # "native" (engine-per-node NativeClusterNode — the whole
+        # decode+handle loop in C), or a {node_id: impl} mapping for
+        # mixed clusters (interop tests).  Native nodes run the stock
+        # SenderQueue(QHB) semantics natively, so they only compose with
+        # the DEFAULT protocol stack and the scalar suite.
+        self._node_impl = node_impl
+        self._batch_size = batch_size
+        self._session_id = session_id
+        if any(self._impl_for(i) == "native" for i in range(n)):
+            if protocol_factory is not None:
+                raise ValueError(
+                    "node_impl='native' runs the stock SenderQueue(QHB) "
+                    "stack in the engine; custom protocol_factory needs "
+                    "node_impl='python'"
+                )
+            if not isinstance(self.suite, ScalarSuite):
+                raise ValueError(
+                    "node_impl='native' requires the scalar suite "
+                    "(the engine's internal-crypto mode)"
+                )
         factory = protocol_factory or _default_protocol_factory(
             batch_size, session_id, n
         )
@@ -285,17 +307,39 @@ class LocalCluster:
         }
         for i, t in transports.items():
             t.set_peers({j: a for j, a in self.addr_map.items() if j != i})
-            self.nodes[i] = ClusterNode(
-                node_id=i,
-                netinfo=build_netinfo(n, self.f, seed, self.suite, i),
-                all_ids=list(range(n)),
-                transport=t,
-                backend=backend_factory(self.suite),
-                suite=self.suite,
-                seed=seed,
-                protocol_factory=factory,
-            )
+            self.nodes[i] = self._make_node(i, t)
         self._started = False
+
+    def _impl_for(self, node_id: int) -> str:
+        if isinstance(self._node_impl, str):
+            return self._node_impl
+        return self._node_impl.get(node_id, "python")
+
+    def _make_node(self, i: int, t: TcpTransport):
+        netinfo = build_netinfo(self.n, self.f, self.seed, self.suite, i)
+        if self._impl_for(i) == "native":
+            from hbbft_tpu.transport.native_node import NativeClusterNode
+
+            return NativeClusterNode(
+                node_id=i,
+                netinfo=netinfo,
+                all_ids=list(range(self.n)),
+                transport=t,
+                suite=self.suite,
+                seed=self.seed,
+                batch_size=self._batch_size,
+                session_id=self._session_id,
+            )
+        return ClusterNode(
+            node_id=i,
+            netinfo=netinfo,
+            all_ids=list(range(self.n)),
+            transport=t,
+            backend=self._backend_factory(self.suite),
+            suite=self.suite,
+            seed=self.seed,
+            protocol_factory=self._factory,
+        )
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -341,16 +385,7 @@ class LocalCluster:
             port=port,
             **self._transport_kwargs,
         )
-        node = ClusterNode(
-            node_id=node_id,
-            netinfo=build_netinfo(self.n, self.f, self.seed, self.suite, node_id),
-            all_ids=list(range(self.n)),
-            transport=t,
-            backend=self._backend_factory(self.suite),
-            suite=self.suite,
-            seed=self.seed,
-            protocol_factory=self._factory,
-        )
+        node = self._make_node(node_id, t)
         self.nodes[node_id] = node
         if self._started:
             t.start()

@@ -1,0 +1,210 @@
+"""Native-engine cluster node: C++ decode+handle loop on real sockets.
+
+The round-8 Python cluster node plateaued at ~4.8k handled msgs/s
+regardless of N because every frame was serde-decoded and protocol-
+stepped by a Python thread, while the same engine moves 1.7M msgs/s
+in-process (BASELINE.md round 8).  This runtime closes that gap with
+the engine's message-boundary API (round 9):
+
+* the transport's burst consumer (``TcpTransport.on_batch``) queues one
+  inbox item per read burst — a list of MSG payloads, not one Python
+  callback per frame;
+* the protocol thread packs each burst into ONE ctypes call
+  (``hbe_node_ingest_frames``: decode + epoch-announce handling +
+  enqueue, all in C), drains the engine's delivery queue with one
+  ``hbe_run``, and hands the accumulated egress frames (serde-encoded
+  and epoch-gated in C — the native SenderQueue mirror) back to
+  ``transport.send``;
+* the per-BATCH layers stay the reused Python stack
+  (``QueueingHoneyBadger`` over :class:`~hbbft_tpu.native_engine.
+  NativeDhb`), fired through the engine's batch callbacks exactly as in
+  :class:`~hbbft_tpu.native_engine.NativeQhbNet`.
+
+The Python :class:`~hbbft_tpu.transport.cluster.ClusterNode` is kept as
+the cross-check oracle: same keys, same rng ritual, same eager
+(``flush_every=1``) crypto cadence — a native cluster at seed s commits
+byte-identical batches to the Python-node cluster at seed s
+(tests/test_transport_native.py pins this, plus the fault drills).
+
+Threading: the protocol thread is the ONLY caller into the engine
+(ingest / run / drain / stats / fault counters — the engine is not
+thread-safe); the transport thread only feeds the bounded inbox, and
+readers snapshot ``outputs`` (a plain list) under the GIL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, List, Optional
+
+from hbbft_tpu.crypto.suite import Suite
+from hbbft_tpu.native_engine import NativeNodeEngine
+from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
+from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.transport.transport import TcpTransport
+from hbbft_tpu.utils.metrics import Metrics
+
+#: Max inbox items coalesced into one processing sweep.  Bounds how
+#: long egress draining can starve behind a flood of inbound bursts;
+#: each item is already a whole read burst, so 256 sweeps ~16 MiB.
+_MAX_COALESCE = 256
+
+
+class NativeClusterNode:
+    """One cluster node backed by a :class:`NativeNodeEngine`.
+
+    Public surface mirrors :class:`~hbbft_tpu.transport.cluster.
+    ClusterNode` (``submit`` / ``batches`` / ``start`` / ``stop`` /
+    ``metrics`` / ``transport``), so :class:`LocalCluster` drives both
+    implementations through one code path.
+    """
+
+    def __init__(
+        self,
+        node_id: int,
+        netinfo: NetworkInfo,
+        all_ids: List[int],
+        transport: TcpTransport,
+        suite: Suite,
+        seed: int,
+        batch_size: int = 8,
+        session_id: bytes = b"tcp-cluster",
+        metrics: Optional[Metrics] = None,
+        inbox_cap: int = 50_000,
+    ) -> None:
+        self.id = node_id
+        self.netinfo = netinfo
+        self.all_ids = list(all_ids)
+        self.transport = transport
+        self.metrics = metrics if metrics is not None else transport.metrics
+        self.engine = NativeNodeEngine(
+            node_id,
+            netinfo,
+            seed=seed,
+            batch_size=batch_size,
+            session_id=session_id,
+            suite=suite,
+        )
+        # Bounded, like ClusterNode.inbox: a peer streaming faster than
+        # the engine drains hits receive-side backpressure (the burst is
+        # refused, the transport drops the connection un-acked, resume
+        # retransmits later) instead of growing memory.
+        self.inbox: "queue.Queue[tuple]" = queue.Queue(maxsize=inbox_cap)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._synced_faults = 0  # engine fault entries already exported
+        transport.on_batch = self._on_frame_burst
+
+    # -- transport thread ----------------------------------------------
+    def _on_frame_burst(self, sender: Any, payloads: List[bytes]) -> int:
+        try:
+            self.inbox.put_nowait(("msgs", sender, payloads))
+        except queue.Full:
+            self.metrics.count("cluster.inbox_overflow")
+            return 0  # nothing consumed: connection drops un-acked
+        return len(payloads)
+
+    # -- any thread ----------------------------------------------------
+    def submit(self, input: Any) -> None:
+        try:
+            self.inbox.put_nowait(("input", input, None))
+        except queue.Full:
+            self.metrics.count("cluster.input_dropped")
+
+    def batches(self) -> List[DhbBatch]:
+        # outputs is append-only on the protocol thread; list() under
+        # the GIL is a consistent snapshot (same guarantee ClusterNode's
+        # lock provides for its outputs list).
+        return list(self.engine.outputs)
+
+    def start(self) -> None:
+        assert self._thread is None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"native-node-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop = True  # flag, not a queue item: survives a full inbox
+        self._thread.join(timeout=10)
+        self._thread = None
+
+    # -- protocol thread -----------------------------------------------
+    def _run(self) -> None:
+        eng = self.engine
+        egress: List[tuple] = []
+        def collect(dest: int, payload: bytes) -> None:
+            egress.append((dest, payload))
+        while not self._stop:
+            try:
+                item = self.inbox.get(timeout=0.2)
+            except queue.Empty:
+                self._sync_engine_counters()
+                continue
+            burst = [item]
+            while len(burst) < _MAX_COALESCE:
+                try:
+                    burst.append(self.inbox.get_nowait())
+                except queue.Empty:
+                    break
+            # Exception scope is per ingest-group/input, NOT the whole
+            # sweep: the coalesced items behind a failing one were
+            # already consumed + ACKed by the transport, so skipping
+            # them would lose acknowledged frames with no retransmit
+            # (the Python node's blast radius is one inbox item —
+            # cluster.py keeps the same stance).  A handler bug must
+            # not take the thread down mid-run either way — count it
+            # loudly; tests assert the counter stays zero.
+            i = 0
+            while i < len(burst):
+                if burst[i][0] == "msgs":
+                    senders: List[int] = []
+                    payloads: List[bytes] = []
+                    while i < len(burst) and burst[i][0] == "msgs":
+                        _, s, pp = burst[i]
+                        senders.extend([s] * len(pp))
+                        payloads.extend(pp)
+                        i += 1
+                    try:
+                        handled = eng.ingest(senders, payloads)
+                        self.metrics.count("cluster.msgs_handled", handled)
+                        bad = len(payloads) - handled
+                        if bad:
+                            self.metrics.count("cluster.bad_payload", bad)
+                        eng.run()
+                    except Exception:
+                        self.metrics.count("cluster.handler_errors")
+                else:  # input
+                    item_input = burst[i][1]
+                    i += 1
+                    try:
+                        eng.handle_input(item_input)
+                    except Exception:
+                        self.metrics.count("cluster.handler_errors")
+            try:
+                egress.clear()
+                eng.drain_egress(collect)
+                if egress:
+                    # one control-plane hand-off for the whole sweep's
+                    # emissions (send_many: one wakeup, one drain op)
+                    self.transport.send_many(egress)
+            except Exception:
+                self.metrics.count("cluster.handler_errors")
+            self._sync_engine_counters()
+
+    def _sync_engine_counters(self) -> None:
+        """Export engine-side fault entries into Metrics (protocol
+        thread only: the engine's fault vector is not thread-safe)."""
+        eng = self.engine
+        if not eng.handle:
+            return
+        total = int(eng.lib.hbe_fault_count(eng.handle, self.id))
+        if total > self._synced_faults:
+            self.metrics.count(
+                "cluster.protocol_faults", total - self._synced_faults
+            )
+            self._synced_faults = total

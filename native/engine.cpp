@@ -1035,6 +1035,54 @@ typedef void (*PreCrankCb)(uint64_t queue_len);
 typedef void (*TamperCb)(int32_t sender, int32_t type, int32_t era,
                          int32_t epoch, int32_t proposer, int32_t round);
 
+// ---------------------------------------------------------------------------
+// Cluster (one-engine-per-node) mode — ISSUE 5
+//
+// The engine normally simulates ALL N nodes behind one internal queue.
+// With hbe_set_local(), it instead drives ONE local node over a real
+// transport: emissions to any other id are serde-encoded into wire
+// frames (the exact bytes Python's serde.dumps(SqMessage.algo(...))
+// would produce — wire_encode_algo) and epoch-gated per peer, a native
+// mirror of protocols/sender_queue.py for a STATIC validator set
+// (join-plan hand-off and deferred removal stay Python-side; the
+// cluster harnesses never change membership).  Ingress frames arrive
+// through hbe_node_ingest_frames as one byte batch per read burst.
+// ---------------------------------------------------------------------------
+
+// One held (ahead-of-window) egress message: SenderQueue._outbox entry.
+struct ClusterHeld {
+  int64_t era, epoch;
+  BytesP payload;
+};
+
+enum ClStat {
+  CL_HANDLED = 0,        // frames decoded to a consumable SqMessage
+  CL_BAD_PAYLOAD = 1,    // cluster.bad_payload mirror (decode rejects)
+  CL_IGNORED = 2,        // codec-valid but non-engine (join_plan, bare hbmsg)
+  CL_DROPPED_STALE = 3,  // egress dropped: behind the peer's window
+  CL_HELD = 4,           // egress held: ahead of the peer's window
+  CL_RELEASED = 5,       // held messages released by a peer announce
+  CL_SENT = 6,           // algo frames handed to the egress buffer
+  CL_ANNOUNCES = 7,      // epoch_started broadcasts emitted
+};
+
+struct ClusterState {
+  int32_t local = -1;  // engine id of the local node; -1 = not cluster mode
+  int32_t window = 3;  // SenderQueue max_future_epochs send gate
+  int64_t ann_era = -1, ann_epoch = -1;  // last announced (era, epoch)
+  std::vector<std::array<int64_t, 2>> peer_epoch;  // last announce per peer
+  std::vector<std::deque<ClusterHeld>> outbox;     // ahead-of-window holds
+  std::vector<std::pair<int32_t, BytesP>> egress;  // drained by the runtime
+  uint64_t egress_bytes = 0;  // payload bytes pending in `egress`
+  // Broadcast encode memo: EngineOps::broadcast emits ONE shared EMsg to
+  // every destination back-to-back; holding the shared_ptr pins the
+  // object so the pointer-identity key can never alias a recycled
+  // address (cleared when the egress batch drains).
+  std::shared_ptr<const EMsg> enc_src;
+  BytesP enc_payload;
+  uint64_t stats[8] = {};  // ClStat counters (hbe_node_stat)
+};
+
 struct Engine {
   int n = 0, f = 0;
   std::vector<Node> nodes;
@@ -1141,6 +1189,9 @@ struct Engine {
   // message type's slot (BA_COIN / HB_DECRYPT) to keep cyc/delivery
   // comparable across the HBBFT_TPU_COIN_RLC A/B.
   bool in_deferred_flush = false;
+  // -- cluster (one-engine-per-node) mode (ISSUE 5) ------------------------
+  // Sequential-only, like the deferred cadences: hbe_run_mt falls back.
+  ClusterState cluster;
 };
 
 const size_t MASK_CACHE_MAX = 4096;
@@ -1386,6 +1437,12 @@ inline size_t lead_verify_chunk(Pending& lead, size_t lo) {
 // delivery is accumulating its emissions for ordered splicing.
 thread_local std::vector<QItem>* tl_emit_sink = nullptr;
 
+// Cluster-mode hooks (defined with the wire codec, after engine_run):
+// route an emission to the epoch-gated egress, and broadcast an
+// epoch_started announce when the local node's (era, epoch) advanced.
+void cluster_emit(Engine& e, int dest, const std::shared_ptr<const EMsg>& msg);
+void cluster_announce(Engine& e);
+
 struct EngineOps {
   Engine& e;
   Node& node;
@@ -1414,6 +1471,13 @@ struct EngineOps {
   // scheduler splices them back IN SOURCE-DELIVERY ORDER, reproducing
   // the sequential FIFO append order exactly (engine_run_mt notes).
   void emit(int dest, std::shared_ptr<const EMsg> msg) {
+    if (e.cluster.local >= 0) {
+      // Cluster mode: only the local node is ever driven, and send/
+      // broadcast already exclude self, so every emission targets a
+      // remote peer — encode + epoch-gate it toward the wire.
+      cluster_emit(e, dest, msg);
+      return;
+    }
     if (tl_emit_sink) tl_emit_sink->push_back({node.id, dest, std::move(msg)});
     else e.queue.push_back({node.id, dest, std::move(msg)});
   }
@@ -3722,6 +3786,11 @@ void engine_unit(Engine& e, Node& node, const std::function<void(Ctx&)>& fn) {
     engine_flush_ext_node(e, node);
   }
   e.depth--;
+  // Cluster mode: announce after each OUTERMOST unit, mirroring
+  // SenderQueue._post's current-epoch check at the end of every handled
+  // step (nested units — era restarts, proposals from batch callbacks —
+  // land inside the outer unit, exactly like Python's nested steps).
+  if (e.depth == 0) cluster_announce(e);
 }
 
 // ---------------------------------------------------------------------------
@@ -3858,7 +3927,753 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
     e.prof_count[ty] += 1;
     if (!node.tampered) engine_count_unit(e);
   }
+  cluster_announce(e);  // no-op outside cluster mode
   return processed;
+}
+
+// ===========================================================================
+// Wire codec: EMsg <-> the serde wire grammar (ISSUE 5)
+//
+// ENCODE produces the exact bytes Python's serde.dumps would emit for
+// SqMessage.algo(DhbMessage(era, HbMessage(...))) over the wire.py
+// registered codecs (canonical ints, ScalarG group id 1, struct layout)
+// — pinned by hbe_wire_roundtrip in the tests.  DECODE mirrors the
+// accept/reject behavior of serde.loads under the ScalarSuite pin plus
+// the wire.py unpackers for the whole SqMessage-reachable tree: a
+// payload is accepted iff Python's `serde.try_loads(data, ScalarSuite)`
+// yields an SqMessage (kind 3 covers codec-valid-but-non-engine values:
+// join plans, bare HbMessage algos — Python consumes and ignores/faults
+// those without committing anything).  Byte-level structure/limits come
+// from hbe_serde_scan with the same caller limits serde.py passes.
+// ===========================================================================
+
+struct WireDecoded {
+  int kind = 0;  // 1 epoch_started, 2 algo engine message, 3 other-accepted
+  int64_t era = 0, epoch = 0;  // epoch_started announce (saturated)
+  EMsg msg;                    // kind 2
+};
+
+inline void wenc_u32(Bytes& o, uint32_t v) {
+  o.push_back((char)(v >> 24));
+  o.push_back((char)(v >> 16));
+  o.push_back((char)(v >> 8));
+  o.push_back((char)v);
+}
+
+// Canonical non-negative int: 0x03, sign 0, minimal big-endian magnitude.
+inline void wenc_nonneg(Bytes& o, uint64_t v) {
+  uint8_t mag[8];
+  int l = 0;
+  while (v) {
+    mag[l++] = (uint8_t)(v & 0xff);
+    v >>= 8;
+  }
+  o.push_back('\x03');
+  o.push_back('\x00');
+  wenc_u32(o, (uint32_t)l);
+  for (int i = l - 1; i >= 0; --i) o.push_back((char)mag[i]);
+}
+
+inline void wenc_str(Bytes& o, const char* s) {
+  size_t l = std::strlen(s);
+  o.push_back('\x05');
+  wenc_u32(o, (uint32_t)l);
+  o.append(s, l);
+}
+
+inline void wenc_bytes(Bytes& o, const uint8_t* p, size_t l) {
+  o.push_back('\x04');
+  wenc_u32(o, (uint32_t)l);
+  o.append((const char*)p, l);
+}
+
+inline void wenc_tuple(Bytes& o, uint32_t count) {
+  o.push_back('\x06');
+  wenc_u32(o, count);
+}
+
+inline void wenc_struct(Bytes& o, const char* name) {
+  size_t l = std::strlen(name);
+  o.push_back('\x10');
+  o.push_back((char)l);
+  o.append(name, l);
+}
+
+inline void wenc_bool(Bytes& o, bool b) { o.push_back(b ? '\x02' : '\x01'); }
+
+inline void wenc_group(Bytes& o, const U256& v) {
+  // ScalarG.serde_group == 1 for BOTH G1- and G2-positioned elements
+  // (one group id in the scalar suite) — encode must match dumps.
+  o.push_back('\x11');
+  size_t l = sizeof(kScalarSuiteName) - 1;
+  o.push_back((char)l);
+  o.append(kScalarSuiteName, l);
+  o.push_back('\x01');
+  wenc_u32(o, 32);
+  uint8_t be[32];
+  u256_to_be32(v, be);
+  o.append((const char*)be, 32);
+}
+
+// sigshare/decshare: fields ("scalar-insecure", <group element>).
+inline void wenc_share_struct(Bytes& o, const char* name, const U256& v) {
+  wenc_struct(o, name);
+  wenc_tuple(o, 2);
+  wenc_str(o, kScalarSuiteName);
+  wenc_group(o, v);
+}
+
+Bytes wire_encode_algo(const EMsg& m) {
+  Bytes o;
+  wenc_struct(o, "sqmsg");
+  wenc_tuple(o, 2);
+  wenc_str(o, "algo");
+  wenc_struct(o, "dhbmsg");
+  wenc_tuple(o, 2);
+  wenc_nonneg(o, (uint64_t)m.era);
+  wenc_struct(o, "hbmsg");
+  wenc_tuple(o, 4);
+  wenc_nonneg(o, (uint64_t)m.epoch);
+  if (m.type == HB_DECRYPT) {
+    wenc_str(o, "decrypt");
+    wenc_nonneg(o, (uint64_t)m.proposer);
+    wenc_struct(o, "decmsg");
+    wenc_tuple(o, 1);
+    wenc_share_struct(o, "decshare", m.share);
+    return o;
+  }
+  wenc_str(o, "subset");
+  o.push_back('\x00');  // HbMessage.proposer is None for subset envelopes
+  wenc_struct(o, "subsetmsg");
+  wenc_tuple(o, 3);
+  wenc_nonneg(o, (uint64_t)m.proposer);
+  switch (m.type) {
+    case BC_VALUE:
+    case BC_ECHO: {
+      wenc_str(o, "bc");
+      wenc_struct(o, m.type == BC_VALUE ? "bc_value" : "bc_echo");
+      wenc_tuple(o, 1);
+      const ProofData& p = *m.proof;
+      wenc_struct(o, "proof");
+      wenc_tuple(o, 4);
+      wenc_bytes(o, (const uint8_t*)p.value.data(), p.value.size());
+      wenc_nonneg(o, (uint64_t)p.index);
+      wenc_tuple(o, (uint32_t)p.path.size());
+      for (const Root& h : p.path) wenc_bytes(o, h.data(), 32);
+      wenc_bytes(o, p.root.data(), 32);
+      break;
+    }
+    case BC_READY:
+    case BC_ECHO_HASH:
+    case BC_CAN_DECODE: {
+      wenc_str(o, "bc");
+      wenc_struct(o, m.type == BC_READY        ? "bc_ready"
+                     : m.type == BC_ECHO_HASH  ? "bc_echohash"
+                                               : "bc_candecode");
+      wenc_tuple(o, 1);
+      wenc_bytes(o, m.root.data(), 32);
+      break;
+    }
+    default: {  // BA_*
+      wenc_str(o, "ba");
+      wenc_struct(o, "ba");
+      wenc_tuple(o, 2);
+      wenc_nonneg(o, (uint64_t)m.round);
+      switch (m.type) {
+        case BA_BVAL:
+        case BA_AUX:
+        case BA_TERM:
+          wenc_struct(o, m.type == BA_BVAL  ? "ba_bval"
+                         : m.type == BA_AUX ? "ba_aux"
+                                            : "ba_term");
+          wenc_tuple(o, 1);
+          wenc_bool(o, m.bval != 0);
+          break;
+        case BA_CONF:
+          wenc_struct(o, "ba_conf");
+          wenc_tuple(o, 1);
+          wenc_struct(o, "bools");
+          wenc_tuple(o, 1);
+          wenc_nonneg(o, m.bval);
+          break;
+        default:  // BA_COIN
+          wenc_struct(o, "ba_coin");
+          wenc_tuple(o, 1);
+          wenc_struct(o, "signmsg");
+          wenc_tuple(o, 1);
+          wenc_share_struct(o, "sigshare", m.share);
+          break;
+      }
+      break;
+    }
+  }
+  return o;
+}
+
+Bytes wire_encode_epoch_started(int64_t era, int64_t epoch) {
+  Bytes o;
+  wenc_struct(o, "sqmsg");
+  wenc_tuple(o, 2);
+  wenc_str(o, "epoch_started");
+  wenc_tuple(o, 2);
+  wenc_nonneg(o, (uint64_t)era);
+  wenc_nonneg(o, (uint64_t)epoch);
+  return o;
+}
+
+// CPython-strict UTF-8 validity (rejects continuations at start,
+// overlongs, surrogates, > U+10FFFF) — needed where Python's decoder
+// utf-8-decodes a FREE string (node ids); fixed-name comparisons reject
+// mismatches byte-wise either way.
+inline bool wire_utf8_ok(const uint8_t* s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t c = s[i];
+    if (c < 0x80) {
+      i += 1;
+    } else if (c < 0xC2) {
+      return false;
+    } else if (c < 0xE0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2;
+    } else if (c < 0xF0) {
+      if (i + 2 >= n) return false;
+      uint8_t c1 = s[i + 1], c2 = s[i + 2];
+      if ((c1 & 0xC0) != 0x80 || (c2 & 0xC0) != 0x80) return false;
+      if (c == 0xE0 && c1 < 0xA0) return false;  // overlong
+      if (c == 0xED && c1 >= 0xA0) return false;  // surrogate
+      i += 3;
+    } else if (c < 0xF5) {
+      if (i + 3 >= n) return false;
+      uint8_t c1 = s[i + 1], c2 = s[i + 2], c3 = s[i + 3];
+      if ((c1 & 0xC0) != 0x80 || (c2 & 0xC0) != 0x80 || (c3 & 0xC0) != 0x80)
+        return false;
+      if (c == 0xF0 && c1 < 0x90) return false;  // overlong
+      if (c == 0xF4 && c1 >= 0x90) return false;  // > U+10FFFF
+      i += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+inline int32_t wire_sat32(int64_t v) {
+  return v > INT32_MAX ? INT32_MAX : (int32_t)v;
+}
+
+// Typed walk over the hbe_serde_scan token stream, mirroring the
+// wire.py validators for the SqMessage-reachable closure.  Rejections
+// may fire for a different REASON than Python's bottom-up build (e.g.
+// wrong-type field before a nested malformed struct), but the verdict
+// is identical — the fuzz-parity tests sweep corruptions to pin it.
+struct WireWalk {
+  const int64_t* t;
+  int64_t ntok;
+  const uint8_t* d;
+  int64_t ti = 0;
+  bool ok = true;
+
+  bool fail() {
+    ok = false;
+    return false;
+  }
+  bool have() const { return ti < ntok; }
+  int64_t tag() const { return t[3 * ti]; }
+  int64_t a() const { return t[3 * ti + 1]; }
+  int64_t b() const { return t[3 * ti + 2]; }
+  static bool eq(const uint8_t* p, int64_t l, const char* s) {
+    size_t n = std::strlen(s);
+    return (uint64_t)l == n && std::memcmp(p, s, n) == 0;
+  }
+
+  // type(v) is int and v >= 0 (bool tags are distinct — automatic).
+  // Values past int64 saturate: acceptance parity matters, the engine
+  // treats the result as "absurdly far future" exactly like Python's
+  // window checks would.
+  bool take_nonneg(int64_t& out) {
+    if (!have() || (tag() & 0xff) != 0x03 || (tag() >> 8) != 0)
+      return fail();
+    int64_t off = a(), l = b();
+    uint64_t v = 0;
+    if (l > 8) {
+      v = (uint64_t)INT64_MAX;
+    } else {
+      for (int64_t i = 0; i < l; ++i) v = (v << 8) | d[off + i];
+      if (v > (uint64_t)INT64_MAX) v = (uint64_t)INT64_MAX;
+    }
+    out = (int64_t)v;
+    ++ti;
+    return true;
+  }
+
+  // wire._node_id: int (any sign/size), utf-8 str, or bytes.  Ids the
+  // engine cannot route decode to the -2 sentinel — delivered, then
+  // faulted as unknown proposer, the Python protocol layer's verdict
+  // for an id outside the validator set.
+  bool take_node_id(int32_t& out) {
+    if (!have()) return fail();
+    int64_t low = tag() & 0xff;
+    if (low == 0x03) {
+      int64_t off = a(), l = b();
+      if ((tag() >> 8) != 0 || l > 4) {
+        out = -2;
+      } else {
+        int64_t v = 0;
+        for (int64_t i = 0; i < l; ++i) v = (v << 8) | d[off + i];
+        out = v <= INT32_MAX ? (int32_t)v : -2;
+      }
+      ++ti;
+      return true;
+    }
+    if (low == 0x05) {
+      if (!wire_utf8_ok(d + a(), (size_t)b())) return fail();
+      out = -2;
+      ++ti;
+      return true;
+    }
+    if (low == 0x04) {
+      out = -2;
+      ++ti;
+      return true;
+    }
+    return fail();
+  }
+
+  bool take_bool(uint8_t& out) {
+    if (!have() || (tag() != 0x01 && tag() != 0x02)) return fail();
+    out = tag() == 0x02 ? 1 : 0;
+    ++ti;
+    return true;
+  }
+
+  bool take_none() {
+    if (!have() || tag() != 0x00) return fail();
+    ++ti;
+    return true;
+  }
+
+  bool take_str(const uint8_t*& p, int64_t& l) {
+    if (!have() || tag() != 0x05) return fail();
+    p = d + a();
+    l = b();
+    ++ti;
+    return true;
+  }
+
+  bool take_bytes(const uint8_t*& p, int64_t& l) {
+    if (!have() || tag() != 0x04) return fail();
+    p = d + a();
+    l = b();
+    ++ti;
+    return true;
+  }
+
+  bool take_root(Root& out) {
+    const uint8_t* p;
+    int64_t l;
+    if (!take_bytes(p, l) || l != 32) return fail();
+    std::memcpy(out.data(), p, 32);
+    return true;
+  }
+
+  bool enter_tuple(uint32_t count) {
+    if (!have() || tag() != 0x06 || a() != (int64_t)count) return fail();
+    ++ti;
+    return true;
+  }
+
+  bool enter_tuple_any(int64_t& count) {
+    if (!have() || tag() != 0x06) return fail();
+    count = a();
+    ++ti;
+    return true;
+  }
+
+  bool enter_struct(const uint8_t*& name, int64_t& nl) {
+    if (!have() || tag() != 0x10) return fail();
+    name = d + a();
+    nl = b();
+    ++ti;
+    return true;
+  }
+
+  // Pinned scalar group element: suite name must be the pin's (loads
+  // rejects any other suite AT the group token), group id 1 or 2 (both
+  // decode through the identical scalar from_bytes), 32 BE bytes < r.
+  bool take_group_scalar(U256& out) {
+    if (!have() || tag() != 0x11) return fail();
+    if (!eq(d + a(), b(), kScalarSuiteName)) return fail();
+    ++ti;
+    if (!have()) return fail();
+    int64_t grp = tag();
+    if ((grp != 1 && grp != 2) || b() != 32) return fail();
+    out = u256_from_be(d + a(), 32);
+    if (!(u256_cmp(out, R_MOD) < 0)) return fail();
+    ++ti;
+    return true;
+  }
+
+  // sigshare/decshare: ("<suite>", elem).  Any other REGISTERED suite
+  // name fails is_g1/is_g2 against the pinned scalar element in Python;
+  // unregistered names fail the suite lookup — reject either way.
+  bool take_share_struct(const char* sname, U256& out) {
+    const uint8_t* nm;
+    int64_t nl;
+    if (!enter_struct(nm, nl) || !eq(nm, nl, sname)) return fail();
+    if (!enter_tuple(2)) return false;
+    const uint8_t* sp;
+    int64_t sl;
+    if (!take_str(sp, sl)) return false;
+    if (!eq(sp, sl, kScalarSuiteName)) return fail();
+    return take_group_scalar(out);
+  }
+
+  bool take_proof(std::shared_ptr<const ProofData>& out) {
+    const uint8_t* nm;
+    int64_t nl;
+    if (!enter_struct(nm, nl) || !eq(nm, nl, "proof")) return fail();
+    if (!enter_tuple(4)) return false;
+    auto p = std::make_shared<ProofData>();
+    const uint8_t* vp;
+    int64_t vl;
+    if (!take_bytes(vp, vl)) return false;
+    p->value.assign((const char*)vp, (size_t)vl);
+    int64_t idx;
+    if (!take_nonneg(idx)) return false;
+    p->index = wire_sat32(idx);  // >= n_leaves either way: invalid-proof
+    int64_t cnt;
+    if (!enter_tuple_any(cnt)) return false;  // empty path is codec-valid
+    p->path.reserve((size_t)cnt);  // scan bounds count by input bytes
+    for (int64_t i = 0; i < cnt; ++i) {
+      Root h;
+      if (!take_root(h)) return false;
+      p->path.push_back(h);
+    }
+    if (!take_root(p->root)) return false;
+    out = std::move(p);
+    return true;
+  }
+};
+
+// HbMessage fields (epoch, kind, proposer, inner) -> EMsg (era left to
+// the caller).  Mirrors wire._unpack_hb_msg + the whole inner tree.
+bool wire_walk_hbmsg_fields(WireWalk& w, EMsg& m) {
+  if (!w.enter_tuple(4)) return false;
+  int64_t epoch;
+  if (!w.take_nonneg(epoch)) return false;
+  m.epoch = wire_sat32(epoch);
+  const uint8_t* kp;
+  int64_t kl;
+  if (!w.take_str(kp, kl)) return false;
+  const uint8_t* nm;
+  int64_t nl;
+  if (WireWalk::eq(kp, kl, "decrypt")) {
+    if (!w.take_node_id(m.proposer)) return false;
+    if (!w.enter_struct(nm, nl) || !WireWalk::eq(nm, nl, "decmsg"))
+      return w.fail();
+    if (!w.enter_tuple(1)) return false;
+    if (!w.take_share_struct("decshare", m.share)) return false;
+    m.type = HB_DECRYPT;
+    return true;
+  }
+  if (!WireWalk::eq(kp, kl, "subset")) return w.fail();
+  if (!w.take_none()) return false;  // subset with a proposer rejects
+  if (!w.enter_struct(nm, nl) || !WireWalk::eq(nm, nl, "subsetmsg"))
+    return w.fail();
+  if (!w.enter_tuple(3)) return false;
+  if (!w.take_node_id(m.proposer)) return false;
+  const uint8_t* sk;
+  int64_t skl;
+  if (!w.take_str(sk, skl)) return false;
+  const uint8_t* in;
+  int64_t il;
+  if (WireWalk::eq(sk, skl, "bc")) {
+    if (!w.enter_struct(in, il)) return false;
+    if (WireWalk::eq(in, il, "bc_value") || WireWalk::eq(in, il, "bc_echo")) {
+      m.type = WireWalk::eq(in, il, "bc_value") ? BC_VALUE : BC_ECHO;
+      if (!w.enter_tuple(1)) return false;
+      std::shared_ptr<const ProofData> pr;
+      if (!w.take_proof(pr)) return false;
+      m.proof = std::move(pr);
+      return true;
+    }
+    if (WireWalk::eq(in, il, "bc_ready") ||
+        WireWalk::eq(in, il, "bc_echohash") ||
+        WireWalk::eq(in, il, "bc_candecode")) {
+      m.type = WireWalk::eq(in, il, "bc_ready")      ? BC_READY
+               : WireWalk::eq(in, il, "bc_echohash") ? BC_ECHO_HASH
+                                                     : BC_CAN_DECODE;
+      if (!w.enter_tuple(1)) return false;
+      return w.take_root(m.root);
+    }
+    return w.fail();
+  }
+  if (!WireWalk::eq(sk, skl, "ba")) return w.fail();
+  if (!w.enter_struct(in, il) || !WireWalk::eq(in, il, "ba")) return w.fail();
+  if (!w.enter_tuple(2)) return false;
+  int64_t rnd;
+  if (!w.take_nonneg(rnd)) return false;
+  m.round = wire_sat32(rnd);
+  const uint8_t* cn;
+  int64_t cl;
+  if (!w.enter_struct(cn, cl)) return false;
+  if (WireWalk::eq(cn, cl, "ba_bval") || WireWalk::eq(cn, cl, "ba_aux") ||
+      WireWalk::eq(cn, cl, "ba_term")) {
+    m.type = WireWalk::eq(cn, cl, "ba_bval")  ? BA_BVAL
+             : WireWalk::eq(cn, cl, "ba_aux") ? BA_AUX
+                                              : BA_TERM;
+    if (!w.enter_tuple(1)) return false;
+    return w.take_bool(m.bval);
+  }
+  if (WireWalk::eq(cn, cl, "ba_conf")) {
+    m.type = BA_CONF;
+    if (!w.enter_tuple(1)) return false;
+    const uint8_t* bn;
+    int64_t bl;
+    if (!w.enter_struct(bn, bl) || !WireWalk::eq(bn, bl, "bools"))
+      return w.fail();
+    if (!w.enter_tuple(1)) return false;
+    int64_t mask;
+    if (!w.take_nonneg(mask) || mask > 3) return w.fail();  // BoolSet 0..3
+    m.bval = (uint8_t)mask;
+    return true;
+  }
+  if (WireWalk::eq(cn, cl, "ba_coin")) {
+    m.type = BA_COIN;
+    if (!w.enter_tuple(1)) return false;
+    const uint8_t* sn;
+    int64_t sl;
+    if (!w.enter_struct(sn, sl) || !WireWalk::eq(sn, sl, "signmsg"))
+      return w.fail();
+    if (!w.enter_tuple(1)) return false;
+    return w.take_share_struct("sigshare", m.share);
+  }
+  return w.fail();
+}
+
+// JoinPlan validation (wire._unpack_join_plan): accepted then IGNORED —
+// SenderQueue's "already joined: nothing to do" — but acceptance parity
+// still matters for the bad_payload counter and the fuzz contract.
+bool wire_walk_joinplan_fields(WireWalk& w) {
+  if (!w.enter_tuple(5)) return false;
+  int64_t era;
+  if (!w.take_nonneg(era)) return false;
+  const uint8_t* sn;
+  int64_t sl;
+  if (!w.take_str(sn, sl)) return false;
+  // Under the pin all commitment elements are scalar; a bls-named plan
+  // fails is_g1 on them, an unregistered name fails the suite lookup.
+  if (!WireWalk::eq(sn, sl, kScalarSuiteName)) return w.fail();
+  const uint8_t* cn;
+  int64_t cl;
+  if (!w.enter_struct(cn, cl) || !WireWalk::eq(cn, cl, "comm"))
+    return w.fail();
+  if (!w.enter_tuple(1)) return false;
+  int64_t elems;
+  if (!w.enter_tuple_any(elems) || elems < 1) return w.fail();
+  for (int64_t i = 0; i < elems; ++i) {
+    U256 v;
+    if (!w.take_group_scalar(v)) return false;
+  }
+  int64_t nval;
+  if (!w.enter_tuple_any(nval) || nval < 1) return w.fail();
+  for (int64_t i = 0; i < nval; ++i) {
+    if (!w.enter_tuple(2)) return false;
+    int32_t id;
+    if (!w.take_node_id(id)) return false;
+    const uint8_t* pn;
+    int64_t pl;
+    if (!w.enter_struct(pn, pl) || !WireWalk::eq(pn, pl, "pk"))
+      return w.fail();
+    if (!w.enter_tuple(2)) return false;
+    const uint8_t* psn;
+    int64_t psl;
+    if (!w.take_str(psn, psl) || !WireWalk::eq(psn, psl, kScalarSuiteName))
+      return w.fail();
+    U256 v;
+    if (!w.take_group_scalar(v)) return false;
+  }
+  const uint8_t* en;
+  int64_t el;
+  if (!w.enter_struct(en, el) || !WireWalk::eq(en, el, "encsched"))
+    return w.fail();
+  if (!w.enter_tuple(2)) return false;
+  const uint8_t* kn;
+  int64_t kl;
+  if (!w.take_str(kn, kl)) return false;
+  if (!(WireWalk::eq(kn, kl, "always") || WireWalk::eq(kn, kl, "never") ||
+        WireWalk::eq(kn, kl, "every_nth") ||
+        WireWalk::eq(kn, kl, "tick_tock")))
+    return w.fail();
+  int64_t schedn;
+  if (!w.take_nonneg(schedn) || schedn < 1) return w.fail();
+  return true;
+}
+
+bool wire_decode_tokens(const int64_t* t, int64_t ntok, const uint8_t* d,
+                        WireDecoded& out) {
+  WireWalk w{t, ntok, d};
+  const uint8_t* nm;
+  int64_t nl;
+  if (!w.enter_struct(nm, nl) || !WireWalk::eq(nm, nl, "sqmsg")) return false;
+  if (!w.enter_tuple(2)) return false;
+  const uint8_t* kp;
+  int64_t kl;
+  if (!w.take_str(kp, kl)) return false;
+  if (WireWalk::eq(kp, kl, "epoch_started")) {
+    if (!w.enter_tuple(2)) return false;
+    if (!w.take_nonneg(out.era) || !w.take_nonneg(out.epoch)) return false;
+    out.kind = 1;
+  } else if (WireWalk::eq(kp, kl, "algo")) {
+    const uint8_t* an;
+    int64_t al;
+    if (!w.enter_struct(an, al)) return false;
+    if (WireWalk::eq(an, al, "dhbmsg")) {
+      if (!w.enter_tuple(2)) return false;
+      int64_t era;
+      if (!w.take_nonneg(era)) return false;
+      const uint8_t* hn;
+      int64_t hl;
+      if (!w.enter_struct(hn, hl) || !WireWalk::eq(hn, hl, "hbmsg"))
+        return false;
+      if (!wire_walk_hbmsg_fields(w, out.msg)) return false;
+      out.msg.era = wire_sat32(era);
+      out.kind = 2;
+    } else if (WireWalk::eq(an, al, "hbmsg")) {
+      // Static-stack HbMessage: codec-valid (SqMessage admits both),
+      // but the dynamic stack faults it as malformed without effect.
+      EMsg scratch;
+      if (!wire_walk_hbmsg_fields(w, scratch)) return false;
+      out.kind = 3;
+    } else {
+      return false;
+    }
+  } else if (WireWalk::eq(kp, kl, "join_plan")) {
+    const uint8_t* jn;
+    int64_t jl;
+    if (!w.enter_struct(jn, jl) || !WireWalk::eq(jn, jl, "joinplan"))
+      return false;
+    if (!wire_walk_joinplan_fields(w)) return false;
+    out.kind = 3;
+  } else {
+    return false;
+  }
+  // The scan already rejects trailing bytes; a fully-consumed token
+  // stream is the tree-level equivalent.
+  return w.ok && w.ti == ntok;
+}
+
+extern "C" int64_t hbe_serde_scan(const uint8_t* data, uint64_t len,
+                                  int64_t* out, uint64_t max_triples,
+                                  int64_t max_depth, uint64_t max_len);
+
+// Full wire decode: structural scan (serde limits) + typed walk.
+bool wire_decode(const uint8_t* data, uint64_t len, WireDecoded& out) {
+  if (len == 0) return false;
+  // Optimistic token buffer with the exact-worst-case retry, like
+  // serde._native_scan (one triple per input byte, +2 for root/group).
+  // Typical frames reuse a thread_local scratch: a fresh zero-
+  // initialized vector per frame was measurable on the burst ingest
+  // path, and the scan only reads triples it wrote.  Oversized frames
+  // (rare multi-MB bc_values) take a one-shot buffer instead so the
+  // retained scratch stays bounded (~2 MB/thread).
+  static thread_local std::vector<int64_t> scratch;
+  std::vector<int64_t> oneshot;
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    uint64_t triples = attempt == 0 ? len / 2 + 64 : len + 2;
+    uint64_t need = 3 * triples;
+    int64_t* bp;
+    if (need <= (1ull << 18)) {
+      if (scratch.size() < need) scratch.resize(need);
+      bp = scratch.data();
+    } else {
+      oneshot.resize(need);
+      bp = oneshot.data();
+    }
+    int64_t rc = hbe_serde_scan(data, len, bp, triples, 64, 1ull << 28);
+    if (rc == -2) continue;  // buffer too small: retry exact
+    if (rc < 0) return false;
+    return wire_decode_tokens(bp, rc, data, out);
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// Cluster egress gating + announcements (SenderQueue semantics)
+// ---------------------------------------------------------------------------
+
+// SenderQueue._admits: 0 send, 1 hold (ahead of window), 2 drop (stale).
+inline int cluster_admit(const std::array<int64_t, 2>& pe, int64_t era,
+                         int64_t epoch, int32_t window) {
+  if (era < pe[0]) return 2;
+  if (era > pe[0]) return 1;
+  if (epoch < pe[1]) return 2;
+  if (epoch > pe[1] + window) return 1;
+  return 0;
+}
+
+void cluster_emit(Engine& e, int dest, const std::shared_ptr<const EMsg>& msg) {
+  ClusterState& c = e.cluster;
+  if (dest < 0 || dest >= e.n || dest == c.local) return;
+  const EMsg& m = *msg;
+  int adm = cluster_admit(c.peer_epoch[dest], m.era, m.epoch, c.window);
+  if (adm == 2) {
+    c.stats[CL_DROPPED_STALE]++;
+    return;
+  }
+  if (c.enc_src.get() != msg.get()) {  // one encode per broadcast
+    c.enc_payload = std::make_shared<const Bytes>(wire_encode_algo(m));
+    c.enc_src = msg;
+  }
+  if (adm == 0) {
+    c.egress.push_back({(int32_t)dest, c.enc_payload});
+    c.egress_bytes += c.enc_payload->size();
+    c.stats[CL_SENT]++;
+  } else {
+    c.outbox[dest].push_back({m.era, m.epoch, c.enc_payload});
+    c.stats[CL_HELD]++;
+  }
+}
+
+void cluster_on_epoch_started(Engine& e, int sender, int64_t era,
+                              int64_t epoch) {
+  ClusterState& c = e.cluster;
+  auto& pe = c.peer_epoch[sender];
+  if (era < pe[0] || (era == pe[0] && epoch <= pe[1])) return;  // stale
+  pe = {era, epoch};
+  std::deque<ClusterHeld> held;
+  held.swap(c.outbox[sender]);
+  for (ClusterHeld& h : held) {
+    int adm = cluster_admit(pe, h.era, h.epoch, c.window);
+    if (adm == 0) {
+      c.egress_bytes += h.payload->size();
+      c.egress.push_back({(int32_t)sender, std::move(h.payload)});
+      c.stats[CL_RELEASED]++;
+    } else if (adm == 1) {
+      c.outbox[sender].push_back(std::move(h));
+    } else {
+      c.stats[CL_DROPPED_STALE]++;
+    }
+  }
+}
+
+void cluster_announce(Engine& e) {
+  ClusterState& c = e.cluster;
+  if (c.local < 0) return;
+  Node& nd = e.nodes[c.local];
+  if (!nd.hb_init) return;
+  int64_t era = nd.era, ep = nd.hb.epoch;
+  if (era == c.ann_era && ep == c.ann_epoch) return;
+  c.ann_era = era;
+  c.ann_epoch = ep;
+  BytesP p = std::make_shared<const Bytes>(wire_encode_epoch_started(era, ep));
+  for (int d = 0; d < e.n; ++d) {
+    if (d == c.local) continue;
+    c.egress.push_back({(int32_t)d, p});
+    c.egress_bytes += p->size();
+  }
+  c.stats[CL_ANNOUNCES]++;
 }
 
 // ---------------------------------------------------------------------------
@@ -4716,9 +5531,10 @@ uint64_t hbe_run_mt(void* h, uint64_t max_deliveries, int32_t n_threads) {
   for (auto& nd : e.nodes) tampered = tampered || nd.tampered;
   // scalar_deferred: the deferred flush cadence is a sequential
   // ordering, exactly like ext mode's (the Python layer also rejects
-  // threads > 1 with a scalar flush_every != 1).
+  // threads > 1 with a scalar flush_every != 1).  Cluster mode is
+  // sequential too (egress buffer + encode memo are single-writer).
   if (n_threads <= 1 || e.ext || e.pre_crank_cb || tampered ||
-      scalar_deferred(e))
+      scalar_deferred(e) || e.cluster.local >= 0)
     return engine_run(e, max_deliveries);
   return engine_run_mt(e, max_deliveries, n_threads);
 }
@@ -4900,6 +5716,7 @@ void hbe_flush(void* h) {
     else if (scalar_deferred(*e))
       engine_flush_scalar(*e);
   }
+  cluster_announce(*e);  // no-op outside cluster mode
 }
 
 // Bytes-return helper for Sign/Combine callbacks: Python calls this with
@@ -4953,6 +5770,143 @@ uint64_t hbe_comb_share_len(void* h, int32_t i) {
 void hbe_comb_share(void* h, int32_t i, uint8_t* out) {
   const Bytes* b = ((Engine*)h)->cur_comb[i].second;
   std::memcpy(out, b->data(), b->size());
+}
+
+// -- cluster (one-engine-per-node) mode ------------------------------------
+//
+// hbe_set_local() switches an engine into cluster mode: only `local` is
+// driven; every emission toward another id is serde-encoded and
+// epoch-gated into an egress buffer (the native SenderQueue mirror —
+// ClusterState notes).  The runtime moves bytes in BATCHES: one
+// hbe_node_ingest_frames call per transport read burst, one
+// hbe_node_egress_drain per run — the message-boundary API that lets a
+// real-socket node keep the whole decode+handle loop native.
+
+void hbe_set_local(void* h, int32_t local, int32_t window) {
+  Engine* e = (Engine*)h;
+  e->cluster.local = local;
+  e->cluster.window = window;
+  e->cluster.peer_epoch.assign(e->n, {0, 0});
+  e->cluster.outbox.assign(e->n, {});
+}
+
+// Ingest one batch of MSG-frame payloads: senders[i] is the (transport-
+// authenticated) peer id of frame i, whose bytes are
+// buf[offsets[i]..offsets[i+1]).  Decoded algo messages queue for the
+// local node (drive with hbe_run); epoch_started announces update the
+// peer window and release held egress; codec-rejects count as
+// bad_payload (CL_BAD_PAYLOAD), exactly the Python node's
+// serde.try_loads + isinstance(SqMessage) gate.  Returns the number of
+// consumable frames, or -1 if not in cluster mode.
+int64_t hbe_node_ingest_frames(void* h, const int32_t* senders,
+                               const uint64_t* offsets, int32_t count,
+                               const uint8_t* buf) {
+  Engine& e = *(Engine*)h;
+  ClusterState& c = e.cluster;
+  if (c.local < 0) return -1;
+  int64_t handled = 0;
+  for (int32_t i = 0; i < count; ++i) {
+    int32_t s = senders[i];
+    const uint8_t* p = buf + offsets[i];
+    uint64_t len = offsets[i + 1] - offsets[i];
+    if (s < 0 || s >= e.n || s == c.local) {
+      c.stats[CL_BAD_PAYLOAD]++;
+      continue;
+    }
+    WireDecoded wm;
+    if (!wire_decode(p, len, wm)) {
+      c.stats[CL_BAD_PAYLOAD]++;
+      continue;
+    }
+    ++handled;
+    c.stats[CL_HANDLED]++;
+    if (wm.kind == 1)
+      cluster_on_epoch_started(e, s, wm.era, wm.epoch);
+    else if (wm.kind == 2)
+      e.queue.push_back(
+          {s, c.local, std::make_shared<const EMsg>(std::move(wm.msg))});
+    else
+      c.stats[CL_IGNORED]++;
+  }
+  return handled;
+}
+
+// Bytes needed to drain the current egress batch (8-byte record header
+// per frame + payload bytes).
+uint64_t hbe_node_egress_bytes(void* h) {
+  ClusterState& c = ((Engine*)h)->cluster;
+  return c.egress_bytes + 8ull * c.egress.size();
+}
+
+// Drain ALL pending egress records into `out` as
+// [dest u32 LE][len u32 LE][payload]*; returns the record count, or -1
+// if `cap` is smaller than hbe_node_egress_bytes() (drains nothing).
+int64_t hbe_node_egress_drain(void* h, uint8_t* out, uint64_t cap) {
+  ClusterState& c = ((Engine*)h)->cluster;
+  uint64_t need = c.egress_bytes + 8ull * c.egress.size();
+  if (need > cap) return -1;
+  uint64_t pos = 0;
+  for (auto& rec : c.egress) {
+    uint32_t dest = (uint32_t)rec.first;
+    uint32_t len = (uint32_t)rec.second->size();
+    out[pos] = (uint8_t)dest;
+    out[pos + 1] = (uint8_t)(dest >> 8);
+    out[pos + 2] = (uint8_t)(dest >> 16);
+    out[pos + 3] = (uint8_t)(dest >> 24);
+    out[pos + 4] = (uint8_t)len;
+    out[pos + 5] = (uint8_t)(len >> 8);
+    out[pos + 6] = (uint8_t)(len >> 16);
+    out[pos + 7] = (uint8_t)(len >> 24);
+    std::memcpy(out + pos + 8, rec.second->data(), len);
+    pos += 8ull + len;
+  }
+  int64_t nrec = (int64_t)c.egress.size();
+  c.egress.clear();
+  c.egress_bytes = 0;
+  c.enc_src = nullptr;  // release the broadcast-memo pin with the batch
+  c.enc_payload = nullptr;
+  return nrec;
+}
+
+// ClStat counters (see the enum): 0 handled, 1 bad_payload, 2 ignored,
+// 3 dropped_stale, 4 held, 5 released, 6 sent, 7 announces.
+uint64_t hbe_node_stat(void* h, int32_t idx) {
+  if (idx < 0 || idx >= 8) return 0;
+  return ((Engine*)h)->cluster.stats[idx];
+}
+
+// -- wire-codec test surface ------------------------------------------------
+
+// Decode verdict for one MSG payload under the scalar pin: -1 reject,
+// 1 epoch_started, 2 algo engine message, 3 codec-valid-but-non-engine
+// (join_plan / bare-HbMessage algo).  Accept (> 0) must track Python's
+// `isinstance(serde.try_loads(data, ScalarSuite()), SqMessage)` exactly
+// — the fuzz-parity tests sweep corruptions against this.
+int32_t hbe_wire_classify(const uint8_t* data, uint64_t len) {
+  WireDecoded wm;
+  return wire_decode(data, len, wm) ? wm.kind : -1;
+}
+
+// Decode + re-encode one payload: pins the C encoder byte-for-byte
+// against serde.dumps for every engine-representable message.  Returns
+// the encoded length, -1 on decode reject, -2 if `cap` is too small,
+// -3 for messages encode cannot represent (kind 3, or node ids outside
+// the engine's int range).
+int64_t hbe_wire_roundtrip(const uint8_t* data, uint64_t len, uint8_t* out,
+                           uint64_t cap) {
+  WireDecoded wm;
+  if (!wire_decode(data, len, wm)) return -1;
+  Bytes enc;
+  if (wm.kind == 1) {
+    enc = wire_encode_epoch_started(wm.era, wm.epoch);
+  } else if (wm.kind == 2 && wm.msg.proposer >= 0) {
+    enc = wire_encode_algo(wm.msg);
+  } else {
+    return -3;
+  }
+  if (enc.size() > cap) return -2;
+  std::memcpy(out, enc.data(), enc.size());
+  return (int64_t)enc.size();
 }
 
 // Fault log accessors (per observing node).
