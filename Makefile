@@ -31,4 +31,12 @@ cluster-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport.py \
 		tests/test_transport_native.py -q -m 'not slow'
 
-.PHONY: lint asan ubsan tsan test-protocol cluster-smoke
+# Traffic-plane tier (ISSUE 6): open-loop clients, mempool pacing/dedup,
+# WAN link shapes, submit→commit latency accounting, kill/restart
+# resubmit drill.  No jax/XLA involvement — safe to run during
+# crypto-cache cold states, like cluster-smoke.
+traffic-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_traffic.py \
+		tests/test_metrics.py -q -m 'not slow'
+
+.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke

@@ -1,0 +1,234 @@
+"""Config 7: traffic plane — open-loop clients over the TCP cluster.
+
+The first benchmark with a latency story: a seeded client fleet offers
+a sustained open-loop load through per-node mempools (paced against
+committed batches), and every transaction is clocked submit→commit, so
+the JSON line carries p50/p99 end-to-end latency next to epochs/s and
+committed txns/s — under clean links and under seeded WAN shapes
+(latency+jitter, optionally loss) from ``wan_profile``.
+
+One JSON line per (N, profile):
+
+    BENCH_TRAFFIC_NS="4,8,16" BENCH_TRAFFIC_PROFILES="clean,wan" \
+        python benchmarks/config7_traffic.py
+
+Drive modes (BENCH_TRAFFIC_DRIVE):
+
+* ``open`` (default) — wall-clock open-loop arrivals for
+  BENCH_TRAFFIC_DURATION_S, then drain.  Throughput and latency
+  percentiles are the honest served-system numbers; cross-arm batch
+  digests are NOT comparable (pacing races the faster arm ahead).
+* ``presubmit`` — the fleet's first BENCH_TRAFFIC_TXNS arrivals are
+  admitted and released in full before start (config6 determinism
+  recipe fed by the client fleet): ``batches_sha`` is comparable
+  across ``BENCH_TRAFFIC_IMPL=python|native`` at one seed.  The
+  latency columns in this mode measure commit order, not
+  client-visible latency — don't quote them.
+
+Profiles: ``clean`` (no injector), ``wan`` (30 ms base + exp jitter on
+every link), ``wan-lossy`` (the same + loss/dup on EVERY link — erodes
+liveness by design, see faults.py), and ``faulty`` (WAN everywhere,
+loss/dup only on ONE node's links — inside the f-tolerance envelope;
+clients are homed on the survivors, so the run measures the cluster
+serving traffic while carrying a degraded member).
+
+Env: BENCH_TRAFFIC_NS (default "4,8,16"), BENCH_TRAFFIC_PROFILES
+(comma list of clean|wan|wan-lossy|faulty, default "clean,wan"),
+BENCH_TRAFFIC_IMPL (python|native, default python),
+BENCH_TRAFFIC_DRIVE (open|presubmit), BENCH_TRAFFIC_DURATION_S
+(default 2.0), BENCH_TRAFFIC_TXNS (presubmit workload, default 32),
+BENCH_TRAFFIC_CLIENTS_PER_NODE (default 2), BENCH_TRAFFIC_TPS
+per client (default ``80/N^2``: QHB at the stock batch_size=8 commits
+~N txns per epoch and Python-arm epochs slow ~quadratically with N on
+this 1-core box, so a FIXED per-client rate drives big-N arms
+hopelessly past capacity — the scaled default keeps every (N, arm)
+inside a drainable envelope; set the env var for an absolute rate),
+BENCH_TRAFFIC_WAN_SCALE (multiplies the profile's time constants,
+default 1.0), BENCH_TRAFFIC_SEED (default 0),
+BENCH_TRAFFIC_DEADLINE_S drain cap (default 120),
+BENCH_TRAFFIC_METRICS=1 to embed the merged metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.traffic import ClientFleet, TrafficDriver  # noqa: E402
+from hbbft_tpu.transport import FaultInjector, LocalCluster  # noqa: E402
+from hbbft_tpu.transport.faults import wan_profile  # noqa: E402
+from hbbft_tpu.utils import serde  # noqa: E402
+
+from config6_tcp_cluster import preload_engine_serde  # noqa: E402
+
+
+def build_injector(profile, n, seed, scale):
+    """Injector (or None) + the id of the degraded node (or None)."""
+    if profile == "clean":
+        return None, None
+    if profile == "faulty":
+        lossy = wan_profile("wan-lossy", scale=scale)
+        victim = n - 1
+        links = {}
+        for i in range(n):
+            if i != victim:
+                links[(i, victim)] = lossy
+                links[(victim, i)] = lossy
+        return (
+            FaultInjector(
+                seed=seed + 1000,
+                default=wan_profile("wan", scale=scale),
+                links=links,
+            ),
+            victim,
+        )
+    lf = wan_profile(profile, scale=scale)
+    return FaultInjector(seed=seed + 1000, default=lf), None
+
+
+def run_one(
+    n: int,
+    profile: str,
+    *,
+    impl: str,
+    drive: str,
+    duration_s: float,
+    txns: int,
+    clients_per_node: int,
+    tps: float,
+    wan_scale: float,
+    seed: int,
+    deadline_s: float,
+) -> dict:
+    injector, victim = build_injector(profile, n, seed, wan_scale)
+    fleet = ClientFleet(clients_per_node * n, tps, seed=seed)
+    rec = {
+        "config": "config7_traffic",
+        "nodes": n,
+        "profile": profile,
+        "node_impl": impl,
+        "drive": drive,
+        "seed": seed,
+        "clients": clients_per_node * n,
+        "offered_tps": round(fleet.offered_tps, 3),
+        "wan_scale": wan_scale,
+        "serde_native": serde._native_scan(serde.dumps(0)) is not None,
+    }
+    cluster = LocalCluster(n, seed=seed, node_impl=impl, injector=injector)
+    # faulty profile: home every client on a survivor — the degraded
+    # node still participates in consensus (that's the point) but no
+    # txn's commit observation depends on its lossy links staying live
+    assign = None
+    if victim is not None:
+        rec["degraded_node"] = victim
+        assign = lambda cid: cid % (n - 1)  # noqa: E731
+    d = TrafficDriver(cluster, fleet, assign=assign)
+    try:
+        if drive == "presubmit":
+            ids = d.run_presubmit(txns)
+            rec["presubmitted"] = len(ids)
+            t0 = time.perf_counter()
+            cluster.start()
+            drained = d.drain(deadline_s)
+            wall = time.perf_counter() - t0
+            res = {
+                "wall_s": wall,
+                "arrived": d.arrived,
+                "admitted": d.admitted,
+                "committed": d.recorder.committed,
+                "outstanding": d.outstanding(),
+            }
+            digest = hashlib.sha256()
+            for b in cluster.batches(0):
+                if not any(c for _, c in b.contributions):
+                    continue  # trailing empty epochs differ across arms
+                digest.update(serde.dumps((b.era, b.epoch, b.contributions)))
+            rec["batches_sha"] = digest.hexdigest()[:16]
+            rec["drained"] = drained
+        else:
+            cluster.start()
+            res = d.run_open_loop(
+                duration_s, drain_timeout_s=deadline_s
+            )
+            wall = res["wall_s"]
+        epochs = min(len(cluster.batches(i)) for i in cluster.nodes)
+        hist = d.recorder.hist
+        m = cluster.merged_metrics()
+        rec.update(
+            {
+                "wall_s": round(wall, 2),
+                "epochs_committed": epochs,
+                "epochs_per_s": round(epochs / wall, 3) if wall else None,
+                "arrived": res["arrived"],
+                "admitted": res["admitted"],
+                "committed_txns": res["committed"],
+                "txns_per_s": round(res["committed"] / wall, 1)
+                if wall
+                else None,
+                "outstanding": res["outstanding"],
+                "lat_p50_s": round(hist.quantile(0.5), 4),
+                "lat_p90_s": round(hist.quantile(0.9), 4),
+                "lat_p99_s": round(hist.quantile(0.99), 4),
+                "lat_max_s": round(hist.max if hist.count else 0.0, 4),
+                "dup_suppressed": m.counters.get("traffic.dup_suppressed", 0),
+                "mempool_overflow": m.counters.get(
+                    "traffic.mempool_overflow", 0
+                ),
+                "frames_shaped": injector.stats.shaped if injector else 0,
+                "frames_dropped": injector.stats.dropped if injector else 0,
+                "protocol_faults": m.counters.get("cluster.protocol_faults", 0),
+                "handler_errors": m.counters.get("cluster.handler_errors", 0),
+                "complete": res["outstanding"] == 0,
+            }
+        )
+        if os.environ.get("BENCH_TRAFFIC_METRICS"):
+            rec["metrics"] = m.to_json()
+    finally:
+        cluster.stop()
+    return rec
+
+
+def main() -> None:
+    ns = [
+        int(x)
+        for x in os.environ.get("BENCH_TRAFFIC_NS", "4,8,16").split(",")
+    ]
+    profiles = os.environ.get("BENCH_TRAFFIC_PROFILES", "clean,wan").split(",")
+    impl = os.environ.get("BENCH_TRAFFIC_IMPL", "python")
+    drive = os.environ.get("BENCH_TRAFFIC_DRIVE", "open")
+    duration = float(os.environ.get("BENCH_TRAFFIC_DURATION_S", "2.0"))
+    txns = int(os.environ.get("BENCH_TRAFFIC_TXNS", "32"))
+    cpn = int(os.environ.get("BENCH_TRAFFIC_CLIENTS_PER_NODE", "2"))
+    tps_env = os.environ.get("BENCH_TRAFFIC_TPS")
+    wan_scale = float(os.environ.get("BENCH_TRAFFIC_WAN_SCALE", "1.0"))
+    seed = int(os.environ.get("BENCH_TRAFFIC_SEED", "0"))
+    deadline = float(os.environ.get("BENCH_TRAFFIC_DEADLINE_S", "120"))
+    preload_engine_serde()
+    for n in ns:
+        # scaled default rate: see the module docstring (fixed rates
+        # drive big-N Python arms hopelessly past capacity)
+        tps = float(tps_env) if tps_env else 80.0 / (n * n)
+        for profile in profiles:
+            rec = run_one(
+                n,
+                profile.strip(),
+                impl=impl,
+                drive=drive,
+                duration_s=duration,
+                txns=txns,
+                clients_per_node=cpn,
+                tps=tps,
+                wan_scale=wan_scale,
+                seed=seed,
+                deadline_s=deadline,
+            )
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

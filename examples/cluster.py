@@ -8,16 +8,27 @@ shows the cluster committing without it, reconnects it, and prints the
 per-peer transport stats + a Prometheus metrics sample.
 
     env JAX_PLATFORMS=cpu python examples/cluster.py
+
+``--traffic`` runs the round-10 traffic plane instead: a seeded
+open-loop client fleet offers paced load through per-node mempools
+for a few seconds (optionally under a WAN link shape with
+``--profile wan``), then prints submit→commit latency percentiles —
+the served-system view of the same cluster.
+
+    env JAX_PLATFORMS=cpu python examples/cluster.py --traffic
+    env JAX_PLATFORMS=cpu python examples/cluster.py --traffic --profile wan
 """
 
 from __future__ import annotations
 
+import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from hbbft_tpu.transport import LocalCluster  # noqa: E402
+from hbbft_tpu.transport import FaultInjector, LocalCluster  # noqa: E402
+from hbbft_tpu.transport.faults import wan_profile  # noqa: E402
 
 
 def main() -> None:
@@ -64,5 +75,52 @@ def main() -> None:
             print(" ", line)
 
 
+def main_traffic(profile: str, duration_s: float) -> None:
+    from hbbft_tpu.traffic import ClientFleet, TrafficDriver
+
+    n = 4
+    lf = wan_profile(profile)
+    injector = FaultInjector(seed=9, default=lf) if lf is not None else None
+    fleet = ClientFleet(num_clients=8, rate_tps_each=5.0, seed=42)
+    print(
+        f"starting {n}-node TCP cluster ({profile} links), offering "
+        f"{fleet.offered_tps:g} txns/s from {len(fleet.clients)} open-loop "
+        f"clients for {duration_s:g}s ..."
+    )
+    with LocalCluster(n, seed=1, injector=injector) as cluster:
+        driver = TrafficDriver(cluster, fleet)
+        res = driver.run_open_loop(duration_s, drain_timeout_s=60.0)
+        hist = driver.recorder.hist
+        print(f"\n  arrived   {res['arrived']}")
+        print(f"  admitted  {res['admitted']}")
+        print(f"  committed {res['committed']}  "
+              f"(outstanding {res['outstanding']})")
+        epochs = min(cluster.batch_count(i) for i in cluster.nodes)
+        print(f"  epochs    {epochs}  ({epochs / res['wall_s']:.2f}/s)")
+        print("\nsubmit→commit latency:")
+        for q in (0.5, 0.9, 0.99):
+            print(f"  p{q * 100:g}  {hist.quantile(q) * 1e3:8.1f} ms")
+        print(f"  max  {hist.max * 1e3:8.1f} ms")
+        if injector is not None:
+            print(f"\n{injector.stats.shaped} frames paid the WAN shape "
+                  f"({injector.stats.dropped} dropped)")
+        print("\nPrometheus latency summary:")
+        for line in cluster.merged_metrics().prometheus_text().splitlines():
+            if "traffic" in line or "faults" in line:
+                print(" ", line)
+
+
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--traffic", action="store_true",
+                    help="run the open-loop traffic-plane demo")
+    ap.add_argument("--profile", default="clean",
+                    choices=("clean", "wan", "wan-lossy"),
+                    help="link shape for --traffic (default clean)")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="offered-load window in seconds (default 3)")
+    args = ap.parse_args()
+    if args.traffic:
+        main_traffic(args.profile, args.duration)
+    else:
+        main()

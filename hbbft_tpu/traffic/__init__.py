@@ -1,0 +1,26 @@
+"""Traffic plane: open-loop clients, mempools, submit→commit latency.
+
+The first subsystem that makes the stack look like a *served* system
+rather than a harness (ISSUE 6): seeded open-loop client fleets
+(:mod:`.clients`), bounded per-node mempools with duplicate
+suppression and commit-paced release (:mod:`.mempool`), bounded-memory
+latency percentiles (:mod:`.latency`), and the driver tying them to a
+live :class:`~hbbft_tpu.transport.cluster.LocalCluster`
+(:mod:`.driver`).  WAN link shapes live with the rest of the fault
+machinery (:func:`hbbft_tpu.transport.faults.wan_profile`).  See
+docs/TRANSPORT.md "traffic plane".
+"""
+
+from hbbft_tpu.traffic.clients import (
+    ClientFleet,
+    OpenLoopClient,
+    make_txn,
+    txn_id_of,
+)
+from hbbft_tpu.traffic.driver import TrafficDriver
+from hbbft_tpu.traffic.latency import (
+    QUANTILES,
+    LatencyHistogram,
+    LatencyRecorder,
+)
+from hbbft_tpu.traffic.mempool import Mempool

@@ -12,8 +12,10 @@ from hbbft_tpu.transport.cluster import ClusterNode, LocalCluster
 from hbbft_tpu.transport.native_node import NativeClusterNode
 from hbbft_tpu.transport.faults import (
     FaultInjector,
+    FaultStats,
     LinkFaults,
     PartitionSpec,
+    wan_profile,
 )
 from hbbft_tpu.transport.framing import (
     KIND_HELLO,

@@ -120,6 +120,7 @@ class ClusterNode:
         self.pool = VerifyPool()
         self.protocol = protocol_factory(netinfo, self.pool, self.rng)
         self.outputs: List[Any] = []
+        self._batches: List[DhbBatch] = []  # outputs filtered once, at append
         self.faults: List[Any] = []
         # Bounded: a peer streaming faster than the protocol thread
         # drains must hit receive-side backpressure (the transport drops
@@ -151,7 +152,19 @@ class ClusterNode:
 
     def batches(self) -> List[DhbBatch]:
         with self._lock:
-            return [o for o in self.outputs if isinstance(o, DhbBatch)]
+            return list(self._batches)
+
+    def batch_count(self) -> int:
+        """O(1) committed-batch count (the traffic driver polls this
+        every tick — copying the whole list just for len() is O(epochs)
+        and QHB grows it forever)."""
+        with self._lock:
+            return len(self._batches)
+
+    def batches_from(self, start: int) -> List[DhbBatch]:
+        """Batches from index ``start`` on — copies only the new tail."""
+        with self._lock:
+            return self._batches[start:]
 
     def start(self) -> None:
         assert self._thread is None
@@ -199,6 +212,9 @@ class ClusterNode:
         if step.output:
             with self._lock:
                 self.outputs.extend(step.output)
+                self._batches.extend(
+                    o for o in step.output if isinstance(o, DhbBatch)
+                )
         if step.fault_log.faults:
             self.faults.extend(step.fault_log.faults)
             self.metrics.count("cluster.protocol_faults", len(step.fault_log.faults))
@@ -409,6 +425,12 @@ class LocalCluster:
     def batches(self, node_id: int) -> List[DhbBatch]:
         return self.nodes[node_id].batches()
 
+    def batch_count(self, node_id: int) -> int:
+        return self.nodes[node_id].batch_count()
+
+    def batches_from(self, node_id: int, start: int) -> List[DhbBatch]:
+        return self.nodes[node_id].batches_from(start)
+
     def wait(
         self,
         pred: Callable[["LocalCluster"], bool],
@@ -463,6 +485,10 @@ class LocalCluster:
             node.transport.export_metrics()
             m.merge(node.metrics)
         m.merge(self.metrics)
+        if self.injector is not None:
+            # injected-fault totals land in the same Prometheus dump as
+            # the transport/cluster counters (faults.* gauges)
+            self.injector.export_metrics(m)
         return m
 
     def transport_stats(self) -> Dict[int, Dict[Any, Dict[str, int]]]:

@@ -33,11 +33,15 @@ thread.
 
 from __future__ import annotations
 
+import math
 import random
+import statistics
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from hbbft_tpu.utils.metrics import Metrics
 
 
 @dataclass(frozen=True)
@@ -65,7 +69,29 @@ class PartitionSpec:
 
 @dataclass
 class LinkFaults:
-    """Per-link fault probabilities (applied frame-by-frame, in order)."""
+    """Per-link fault probabilities (applied frame-by-frame, in order).
+
+    Two delay models coexist:
+
+    * ``delay_p``/``delay_s`` — the ROUND-8 *reorder fault*: occasional
+      frames are held while later ones overtake them (per-frame delay
+      with no ordering constraint — how reordering manifests on this
+      layer).
+    * ``latency_s``/``jitter_s``/``jitter_dist`` — the ROUND-10 *WAN
+      stream shape*: EVERY frame pays a base one-way latency plus a
+      seeded jitter draw, and release times are clamped monotone per
+      link, because a talking pair shares one TCP stream — a real WAN
+      delays the stream, it does not reorder inside it.  Jitter
+      distributions (all driven by one uniform draw via inverse CDF, so
+      the per-link verdict stream stays a pure function of the frame
+      index): ``"uniform"`` (U(0,1)·jitter_s), ``"exp"`` (mean
+      jitter_s — heavy-ish tail, the default), ``"lognormal"``
+      (median jitter_s, shape 0.6 — the long-tail shape WAN RTT
+      studies report).
+
+    Both models compose (WAN shape + occasional reorder fault); loss on
+    a WAN link is the existing ``drop_p``.
+    """
 
     drop_p: float = 0.0
     dup_p: float = 0.0
@@ -73,6 +99,59 @@ class LinkFaults:
     delay_s: Tuple[float, float] = (0.01, 0.05)  # uniform range when delayed
     corrupt_p: float = 0.0
     max_flips: int = 3  # bit flips per corrupted frame (>= 1)
+    # WAN stream shape (applies to every frame when nonzero)
+    latency_s: float = 0.0
+    jitter_s: float = 0.0
+    jitter_dist: str = "exp"  # "exp" | "uniform" | "lognormal"
+
+    def wan_delay(self, u: float) -> float:
+        """Map one uniform draw to this link's per-frame WAN delay."""
+        if self.latency_s <= 0.0 and self.jitter_s <= 0.0:
+            return 0.0
+        j = 0.0
+        if self.jitter_s > 0.0:
+            if self.jitter_dist == "uniform":
+                j = self.jitter_s * u
+            elif self.jitter_dist == "lognormal":
+                z = statistics.NormalDist().inv_cdf(
+                    min(max(u, 1e-12), 1.0 - 1e-12)
+                )
+                j = self.jitter_s * math.exp(0.6 * z)
+            else:  # "exp" (default): inverse CDF of Exp(1/jitter_s)
+                j = -self.jitter_s * math.log(max(1.0 - u, 1e-300))
+        return self.latency_s + j
+
+
+def wan_profile(name: str, scale: float = 1.0) -> Optional[LinkFaults]:
+    """Named WAN link shapes for benchmarks/tests (``config7_traffic``).
+
+    ``"clean"`` → None (no injector needed); ``"wan"`` → ~30 ms base
+    one-way latency + exponential jitter (mean 10 ms), lossless —
+    the continental-WAN shape of the original HoneyBadgerBFT
+    evaluation, scaled down so localhost epochs still close inside
+    test budgets; ``"wan-lossy"`` → the same shape plus 0.5% frame
+    loss and 0.2% duplication.  ``scale`` multiplies the time
+    constants (1.0 = the named shape).  Loss is real loss — dropped
+    frames are never retransmitted unless the connection itself
+    cycles (docs/TRANSPORT.md "loss model") — so lossy profiles on
+    EVERY link erode liveness; the config7 "faulty" arm instead puts
+    loss on one node's links, inside the f-tolerance envelope.
+    """
+    if name == "clean":
+        return None
+    if name == "wan":
+        return LinkFaults(
+            latency_s=0.030 * scale, jitter_s=0.010 * scale, jitter_dist="exp"
+        )
+    if name == "wan-lossy":
+        return LinkFaults(
+            latency_s=0.030 * scale,
+            jitter_s=0.010 * scale,
+            jitter_dist="exp",
+            drop_p=0.005,
+            dup_p=0.002,
+        )
+    raise ValueError(f"unknown WAN profile {name!r} (clean|wan|wan-lossy)")
 
 
 @dataclass
@@ -86,13 +165,29 @@ class FaultStats:
     delayed: int = 0
     corrupted: int = 0
     partitioned: int = 0
+    shaped: int = 0  # frames that paid a WAN latency/jitter delay
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
 
+    NAMES = ("dropped", "duplicated", "delayed", "corrupted", "partitioned",
+             "shaped")
+
     def bump(self, name: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + n)
+
+    def export_metrics(self, m: Metrics, prefix: str = "faults") -> None:
+        """Publish the totals as gauges (``faults.dropped`` etc.) so
+        injected faults land in the same Prometheus dump as the
+        transport/cluster counters (ISSUE 6 satellite).  Gauges, not
+        counters: these are cross-link running totals owned here, and
+        re-exporting monotone totals through ``Metrics.count`` would
+        double-add on every export."""
+        with self._lock:
+            vals = [(name, getattr(self, name)) for name in self.NAMES]
+        for name, v in vals:
+            m.gauge(f"{prefix}.{name}", v)
 
 
 class FaultInjector:
@@ -119,6 +214,9 @@ class FaultInjector:
         self.partitions = list(partitions or [])
         self.stats = FaultStats()
         self._rngs: Dict[Tuple, random.Random] = {}
+        # WAN FIFO state: last scheduled release time per link (only
+        # touched by src's transport thread, like _rngs)
+        self._wan_last: Dict[Tuple, float] = {}
         self._t0: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------
@@ -133,6 +231,11 @@ class FaultInjector:
         if self._t0 is None:
             return 0.0
         return time.monotonic() - self._t0
+
+    def export_metrics(self, m: Metrics, prefix: str = "faults") -> None:
+        """Mirror :meth:`FaultStats.export_metrics` at the injector
+        level (what :meth:`LocalCluster.merged_metrics` calls)."""
+        self.stats.export_metrics(m, prefix)
 
     # -- dynamic schedule edits (tests drive heal explicitly) ----------
     def add_partition(self, spec: PartitionSpec) -> None:
@@ -177,6 +280,7 @@ class FaultInjector:
         r_delay = rng.random()
         u_delay = rng.random()
         r_corrupt = rng.random()
+        u_jitter = rng.random()  # round 10: WAN jitter draw
         if lf.drop_p and r_drop < lf.drop_p:
             self.stats.bump('dropped')
             return []
@@ -192,6 +296,24 @@ class FaultInjector:
             lo, hi = lf.delay_s
             delay = lo + (hi - lo) * u_delay
             self.stats.bump('delayed')
+        wan = lf.wan_delay(u_jitter)
+        if wan > 0.0:
+            # WAN stream shape: base+jitter on every frame, release
+            # times clamped monotone per link — a talking pair shares
+            # one TCP stream, so the WAN delays the stream without
+            # reordering inside it.  The reorder fault (delay_p above)
+            # is added AFTER the clamp: a delay-faulted frame is held
+            # past its WAN slot and CAN still be overtaken by later
+            # frames, so composing the shape with delay_p keeps real
+            # reorder coverage (feeding the reorder delay into the
+            # clamp would silently FIFO it away).
+            release = t + wan
+            last = self._wan_last.get((src, dst), 0.0)
+            if release < last:
+                release = last
+            self._wan_last[(src, dst)] = release
+            delay += release - t
+            self.stats.bump('shaped')
         out = [(delay, data)]
         if lf.dup_p and r_dup < lf.dup_p:
             self.stats.bump('duplicated')

@@ -118,6 +118,12 @@ class NativeClusterNode:
         # lock provides for its outputs list).
         return list(self.engine.outputs)
 
+    def batch_count(self) -> int:
+        return len(self.engine.outputs)  # len() is GIL-atomic
+
+    def batches_from(self, start: int) -> List[DhbBatch]:
+        return self.engine.outputs[start:]
+
     def start(self) -> None:
         assert self._thread is None
         self._stop = False

@@ -48,6 +48,24 @@ class TimerStats:
 
 
 @dataclass
+class SummaryStats:
+    """A quantile snapshot of some distribution (latency percentiles).
+
+    Unlike :class:`TimerStats` (which accumulates raw observations),
+    this is a point-in-time EXPORT: the producer owns the streaming
+    estimator (e.g. :class:`hbbft_tpu.traffic.latency.LatencyHistogram`)
+    and re-publishes count/sum/quantiles whenever it likes — last write
+    wins, like gauges.  Keeping the estimator out of Metrics keeps
+    Metrics plain data and lets producers pick their own accuracy/
+    memory trade-off.
+    """
+
+    count: int = 0
+    total: float = 0.0
+    quantiles: Dict[float, float] = field(default_factory=dict)
+
+
+@dataclass
 class Metrics:
     """Counters + gauges + timers; cheap enough to leave on.
 
@@ -61,6 +79,7 @@ class Metrics:
     counters: Dict[str, int] = field(default_factory=lambda: defaultdict(int))
     timers: Dict[str, TimerStats] = field(default_factory=dict)
     gauges: Dict[str, float] = field(default_factory=dict)
+    summaries: Dict[str, SummaryStats] = field(default_factory=dict)
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False, compare=False
     )
@@ -74,6 +93,23 @@ class Metrics:
         last write wins, unlike the monotonic counters."""
         with self._lock:
             self.gauges[name] = value
+
+    def summary(
+        self,
+        name: str,
+        quantiles: Dict[float, float],
+        count: int,
+        total: float,
+    ) -> None:
+        """Publish a quantile snapshot (gauge semantics: last write
+        wins).  ``quantiles`` maps q in [0, 1] to the estimated value at
+        that quantile; ``count``/``total`` are the observation count and
+        sum backing the estimate (the Prometheus summary ``_count`` /
+        ``_sum`` pair)."""
+        with self._lock:
+            self.summaries[name] = SummaryStats(
+                count=count, total=total, quantiles=dict(quantiles)
+            )
 
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -111,15 +147,29 @@ class Metrics:
             # gauges are point-in-time: the merged-in value wins (merge
             # order is "newer last" everywhere this is used)
             self.gauges.update(list(other.gauges.items()))
+            # summaries share gauge semantics (snapshots, newest wins)
+            self.summaries.update(list(other.summaries.items()))
 
-    def _snapshot(self) -> Tuple[Dict[str, int], Dict[str, float], Dict[str, TimerStats]]:
+    def _snapshot(
+        self,
+    ) -> Tuple[
+        Dict[str, int],
+        Dict[str, float],
+        Dict[str, TimerStats],
+        Dict[str, SummaryStats],
+    ]:
         """Consistent copies for the export methods — they may run on a
         scrape thread while the owning threads keep inserting keys."""
         with self._lock:
-            return dict(self.counters), dict(self.gauges), dict(self.timers)
+            return (
+                dict(self.counters),
+                dict(self.gauges),
+                dict(self.timers),
+                dict(self.summaries),
+            )
 
     def report(self) -> str:
-        counters, gauges, timers = self._snapshot()
+        counters, gauges, timers, summaries = self._snapshot()
         lines = []
         if counters:
             lines.append("counters:")
@@ -137,6 +187,14 @@ class Metrics:
                     f"  {k:<40} {st.count:>6} {st.mean_s * 1e3:>9.2f} "
                     f"{st.max_s * 1e3:>9.2f} {st.total_s:>8.2f}"
                 )
+        if summaries:
+            lines.append("summaries:  (count / quantiles)")
+            for k in sorted(summaries):
+                sm = summaries[k]
+                qs = " ".join(
+                    f"p{q * 100:g}={v:.6g}" for q, v in sorted(sm.quantiles.items())
+                )
+                lines.append(f"  {k:<40} {sm.count:>6} {qs}")
         return "\n".join(lines) or "(no metrics)"
 
     # -- exports --------------------------------------------------------
@@ -144,8 +202,8 @@ class Metrics:
         """Plain-data snapshot (counters, gauges, timer stats) for JSON
         benchmark lines (benchmarks/scale_native.py,
         benchmarks/config6_tcp_cluster.py dump this)."""
-        counters, gauges, timers = self._snapshot()
-        return {
+        counters, gauges, timers, summaries = self._snapshot()
+        out: Dict[str, Any] = {
             "counters": counters,
             "gauges": gauges,
             "timers": {
@@ -158,6 +216,20 @@ class Metrics:
                 for k, st in timers.items()
             },
         }
+        if summaries:
+            out["summaries"] = {
+                k: {
+                    "count": sm.count,
+                    "total": sm.total,
+                    # JSON object keys must be strings; %g keeps 0.5
+                    # and 0.99 readable and round-trippable
+                    "quantiles": {
+                        f"{q:g}": v for q, v in sorted(sm.quantiles.items())
+                    },
+                }
+                for k, sm in summaries.items()
+            }
+        return out
 
     def prometheus_text(self, prefix: str = "hbbft") -> str:
         """Prometheus exposition format (text/plain version 0.0.4).
@@ -177,7 +249,7 @@ class Metrics:
                 .replace("\n", "\\n")
             )
 
-        counters, gauges, timers = self._snapshot()
+        counters, gauges, timers, summaries = self._snapshot()
         lines: List[str] = []
         if counters:
             lines.append(f"# TYPE {prefix}_count counter")
@@ -201,6 +273,21 @@ class Metrics:
                 lines.append(
                     f'{prefix}_timer_seconds_sum{{name="{esc(k)}"}} '
                     f"{st.total_s:.12g}"
+                )
+        if summaries:
+            lines.append(f"# TYPE {prefix}_summary summary")
+            for k in sorted(summaries):
+                sm = summaries[k]
+                for q, v in sorted(sm.quantiles.items()):
+                    lines.append(
+                        f'{prefix}_summary{{name="{esc(k)}",quantile="{q:g}"}} '
+                        f"{v:.12g}"
+                    )
+                lines.append(
+                    f'{prefix}_summary_sum{{name="{esc(k)}"}} {sm.total:.12g}'
+                )
+                lines.append(
+                    f'{prefix}_summary_count{{name="{esc(k)}"}} {sm.count}'
                 )
         return "\n".join(lines) + ("\n" if lines else "")
 

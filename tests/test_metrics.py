@@ -86,6 +86,38 @@ def test_prometheus_text_format():
     assert Metrics().prometheus_text() == ""
 
 
+def test_summary_quantile_export():
+    """Summary/quantile path (ISSUE 6 satellite): snapshot semantics
+    like gauges (last write wins, newest wins on merge), and the
+    Prometheus summary exposition triplet (quantile series + _sum +
+    _count)."""
+    m = Metrics()
+    m.summary("lat", {0.5: 0.010, 0.99: 0.200}, count=100, total=1.5)
+    m.summary("lat", {0.5: 0.012, 0.99: 0.250}, count=150, total=2.5)
+    sm = m.summaries["lat"]
+    assert sm.count == 150 and sm.total == 2.5
+    assert sm.quantiles == {0.5: 0.012, 0.99: 0.250}
+
+    other = Metrics()
+    other.summary("lat", {0.5: 0.020}, count=7, total=0.2)
+    m.merge(other)
+    assert m.summaries["lat"].count == 7  # newest-wins, like gauges
+
+    m.summary("lat", {0.5: 0.012, 0.99: 0.250}, count=150, total=2.5)
+    text = m.prometheus_text()
+    assert "# TYPE hbbft_summary summary" in text
+    assert 'hbbft_summary{name="lat",quantile="0.5"} 0.012' in text
+    assert 'hbbft_summary{name="lat",quantile="0.99"} 0.25' in text
+    assert 'hbbft_summary_sum{name="lat"} 2.5' in text
+    assert 'hbbft_summary_count{name="lat"} 150' in text
+
+    import json
+
+    snap = json.loads(json.dumps(m.to_json()))
+    assert snap["summaries"]["lat"]["quantiles"]["0.99"] == 0.25
+    assert "lat" in m.report() and "p99" in m.report()
+
+
 def test_epoch_tracker():
     t = EpochTracker()
     t.start((0, 0), 1.0)

@@ -1,0 +1,397 @@
+"""Traffic plane (ISSUE 6 acceptance surface).
+
+Unit tier: client-fleet determinism, bounded-memory latency accounting
+(fixed bucket array + capped in-flight map), mempool dedup/overflow/
+pacing, WAN link shapes, injector→Metrics wiring.  Cluster tier: a
+paced open-loop run on an N=4 TCP cluster commits every admitted
+transaction exactly once (no loss, no dups) on BOTH node impls; a
+deterministic presubmitted workload commits byte-identical streams
+across the Python and native arms; a kill/restart drill where the
+client resubmits in-flight transactions still yields an exactly-once
+committed stream (duplicate suppression under churn).
+
+Budget on the 1-core box: cluster phases are single-digit seconds each
+with the standard 45 s caps; whole default tier ~15 s warm (CLAUDE.md
+"traffic tier").  No jax/XLA involvement.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from hbbft_tpu.traffic import (
+    ClientFleet,
+    LatencyHistogram,
+    LatencyRecorder,
+    Mempool,
+    TrafficDriver,
+    txn_id_of,
+)
+from hbbft_tpu.transport import (
+    FaultInjector,
+    LinkFaults,
+    LocalCluster,
+    wan_profile,
+)
+from hbbft_tpu.utils import serde
+from hbbft_tpu.utils.metrics import Metrics
+
+EPOCH_TIMEOUT_S = 45  # wall cap per driven phase; typical is < 2 s
+
+
+def _lib_or_skip():
+    from hbbft_tpu import native_engine
+
+    lib = native_engine.get_lib()
+    if lib is None:
+        pytest.skip("native engine unavailable (no compiler?)")
+    return lib
+
+
+def _stream_txns(cluster, nid):
+    """All transactions in node ``nid``'s committed stream, in order."""
+    out = []
+    for b in cluster.batches(nid):
+        for _proposer, contrib in b.contributions:
+            if isinstance(contrib, (list, tuple)):
+                out.extend(t for t in contrib if isinstance(t, str))
+    return out
+
+
+def batch_keys(cluster, nid):
+    return [
+        (b.era, b.epoch, serde.dumps(b.contributions))
+        for b in cluster.batches(nid)
+    ]
+
+
+def _wait_streams_cover(c, nodes, expect):
+    """drain() returns on FIRST sighting of each commit (some node), so
+    a lagging node's stream can still be a prefix — wait until every
+    listed node's committed stream covers ``expect`` before asserting
+    over per-node streams."""
+    assert c.wait(
+        lambda cl: all(
+            expect <= {txn_id_of(t) for t in _stream_txns(cl, i)}
+            for i in nodes
+        ),
+        EPOCH_TIMEOUT_S,
+    ), "lagging node never caught up"
+
+
+# ---------------------------------------------------------------------------
+# clients
+# ---------------------------------------------------------------------------
+
+
+def test_client_fleet_deterministic_and_attributable():
+    a = ClientFleet(4, 10.0, seed=7)
+    b = ClientFleet(4, 10.0, seed=7)
+    wa, wb = a.take(60), b.take(60)
+    assert wa == wb  # same seed -> identical stream
+    assert ClientFleet(4, 10.0, seed=8).take(60) != wa
+    ts = [t for t, _, _, _ in wa]
+    assert ts == sorted(ts)  # merged in arrival order
+    ids = [tid for _, _, tid, _ in wa]
+    assert len(ids) == len(set(ids))  # (client, seq) ids are unique
+    for _, cid, tid, txn in wa:
+        assert tid == txn == f"c{cid}." + tid.split(".")[1]
+        assert txn_id_of(txn) == tid
+    # fixed-rate arrivals are exactly periodic per client
+    f = ClientFleet(2, 5.0, seed=0, arrival="fixed")
+    w = f.take(10)
+    assert [t for t, _, _, _ in w] == pytest.approx(
+        [0.2, 0.2, 0.4, 0.4, 0.6, 0.6, 0.8, 0.8, 1.0, 1.0]
+    )
+    # payload padding is attributable back to the same id
+    p = ClientFleet(1, 1.0, seed=1, payload_len=32).take(1)[0]
+    assert len(p[3]) > len(p[2]) and txn_id_of(p[3]) == p[2]
+
+
+# ---------------------------------------------------------------------------
+# latency accounting: bounded memory, honest quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_fixed_memory_and_quantiles():
+    h = LatencyHistogram()
+    nbuckets = len(h)
+    assert h.quantile(0.5) == 0.0  # empty
+    rng = random.Random(42)
+    vals = [rng.uniform(0.001, 1.0) for _ in range(10_000)]
+    for v in vals:
+        h.observe(v)
+    assert len(h) == nbuckets  # fixed bucket array, no growth
+    assert h.count == 10_000 and h.max == max(vals) and h.min == min(vals)
+    vs = sorted(vals)
+    for q in (0.5, 0.9, 0.99):
+        exact = vs[int(q * len(vs)) - 1]
+        assert abs(h.quantile(q) - exact) / exact < 0.10  # ~7% buckets
+    assert h.quantile(1.0) <= h.max
+    # out-of-range values clamp into the edge buckets, never explode
+    h.observe(0.0)
+    h.observe(1e9)
+    assert len(h) == nbuckets and h.max == 1e9
+
+
+def test_recorder_inflight_bounded_and_first_sighting():
+    r = LatencyRecorder(max_inflight=10)
+    for i in range(15):
+        r.submit(f"t{i}", 0.0)
+    assert r.inflight() == 10 and r.untracked == 5
+    assert r.submit("t0", 99.0) is False  # resubmit keeps original clock
+    dt = r.commit("t0", 2.5)
+    assert dt == 2.5 and r.committed == 1
+    assert r.commit("t0", 3.0) is None  # second sighting: not clocked
+    assert r.commit("never-seen", 1.0) is None
+    r.drop("t1")
+    assert r.dropped == 1 and r.inflight() == 8
+    m = Metrics()
+    r.export(m)
+    assert m.summaries["traffic.latency_s"].count == 1
+    assert m.gauges["traffic.latency_s.inflight"] == 8
+
+
+# ---------------------------------------------------------------------------
+# mempool: dedup, drop-oldest overflow, pacing
+# ---------------------------------------------------------------------------
+
+
+def test_mempool_dedup_overflow_pacing():
+    released, dropped = [], []
+    m = Metrics()
+    mp = Mempool(
+        released.append, cap=5, round_txns=2, ahead=1,
+        committed_cache=4, metrics=m, on_drop=dropped.append,
+    )
+    assert mp.admit("a", "a-txn") and not mp.admit("a", "a-txn")
+    assert m.counters["traffic.dup_suppressed"] == 1
+    for x in "bcdef":
+        mp.admit(x, x)
+    # cap 5: admitting "f" shed the oldest ("a")
+    assert len(mp) == 5 and dropped == ["a"]
+    assert m.counters["traffic.mempool_overflow"] == 1
+    # pacing: committed=0 -> (0+1)*2 = 2 released
+    assert mp.pace(0) == 2 and released == ["b", "c"]
+    assert mp.pace(0) == 0  # budget spent
+    assert mp.pace(1) == 2 and released == ["b", "c", "d", "e"]
+    # released-but-uncommitted ids are still dup-suppressed
+    assert not mp.admit("b", "b")
+    mp.mark_committed(["b", "c"])
+    assert not mp.admit("b", "b")  # now suppressed by the committed LRU
+    assert [t for t, _ in mp.inflight_released()] == ["d", "e"]
+    # a committed id that was still queued is tombstoned, never released
+    mp.mark_committed(["f"])
+    assert mp.pace(2) == 0 and len(mp) == 0  # "f" skipped as a tombstone
+    # node restart: committed count goes backwards -> budget rebases
+    mp.admit("g", "g")
+    assert mp.pace(0) == 1 and released[-1] == "g"
+    # committed LRU is bounded and evictions are counted
+    mp.mark_committed([f"z{i}" for i in range(10)])
+    assert m.counters["traffic.committed_evicted"] > 0
+
+
+# ---------------------------------------------------------------------------
+# WAN link shapes + injector metrics wiring
+# ---------------------------------------------------------------------------
+
+
+def test_wan_profile_shapes_deterministic():
+    assert wan_profile("clean") is None
+    with pytest.raises(ValueError):
+        wan_profile("marsnet")
+    lf = wan_profile("wan")
+    # pure function of the uniform draw: same u -> same delay
+    assert lf.wan_delay(0.37) == lf.wan_delay(0.37) > lf.latency_s
+    for dist in ("uniform", "exp", "lognormal"):
+        d = LinkFaults(latency_s=0.01, jitter_s=0.005, jitter_dist=dist)
+        lo, hi = d.wan_delay(0.05), d.wan_delay(0.95)
+        assert 0.01 <= lo < hi  # monotone in u, floored at the base
+    assert LinkFaults().wan_delay(0.5) == 0.0  # shape off by default
+
+
+def test_wan_injector_fifo_and_stats():
+    inj = FaultInjector(seed=5, default=wan_profile("wan"))
+    inj.start()
+    last = 0.0
+    for k in range(200):
+        plan = inj.on_send(0, 1, b"frame-%d" % k)
+        assert len(plan) == 1 and plan[0][0] >= wan_profile("wan").latency_s
+        rel = inj._wan_last[(0, 1)]
+        assert rel >= last  # stream order preserved (FIFO clamp)
+        last = rel
+    assert inj.stats.shaped == 200 and inj.stats.dropped == 0
+    m = Metrics()
+    inj.export_metrics(m)
+    assert m.gauges["faults.shaped"] == 200
+
+
+def test_wan_shape_composes_with_reorder_fault():
+    """The reorder fault (delay_p) must keep reordering when a WAN
+    shape is on: the reorder delay rides ON TOP of the monotone WAN
+    release clamp (folding it into the clamp would silently FIFO the
+    fault away while still counting 'delayed')."""
+    lf = LinkFaults(latency_s=0.01, delay_p=0.3, delay_s=(0.5, 0.5))
+    inj = FaultInjector(seed=7, default=lf)
+    inj.start()
+    rel = []
+    t0 = time.monotonic()
+    for k in range(50):
+        plan = inj.on_send(0, 1, b"f%d" % k)
+        rel.append((time.monotonic() - t0) + plan[0][0])
+    assert inj.stats.delayed > 0 and inj.stats.shaped == 50
+    # delay-faulted frames (+0.5 s) are overtaken by later clean ones
+    assert any(
+        rel[i] > rel[j] for i in range(len(rel)) for j in range(i + 1, len(rel))
+    ), "WAN shape FIFO'd the reorder fault away"
+
+
+def test_fault_stats_reach_cluster_prometheus_dump():
+    """Satellite: FaultInjector totals show up in the same Prometheus
+    dump as the transport/cluster counters via merged_metrics()."""
+    inj = FaultInjector(seed=1, default=LinkFaults(drop_p=1.0))
+    assert inj.on_send(0, 1, b"abc") == []  # dropped
+    cluster = LocalCluster(4, seed=2, injector=inj)  # never started
+    text = cluster.merged_metrics().prometheus_text()
+    assert 'hbbft_gauge{name="faults.dropped"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# acceptance: paced open-loop, exactly-once, both node impls
+# ---------------------------------------------------------------------------
+
+
+def _run_open_loop(impl):
+    fleet = ClientFleet(8, 5.0, seed=3)  # 40 offered tps across 8 users
+    with LocalCluster(4, seed=17, node_impl=impl) as c:
+        d = TrafficDriver(c, fleet)
+        res = d.run_open_loop(2.0, drain_timeout_s=EPOCH_TIMEOUT_S)
+        assert res["outstanding"] == 0, res
+        assert res["admitted"] == res["arrived"] > 20  # fresh ids: no dups
+        assert res["committed"] == res["admitted"], res
+        assert d.recorder.hist.count == res["committed"]
+        assert d.recorder.hist.quantile(0.5) > 0.0
+        # exactly-once in EVERY node's committed stream
+        expect = set(
+            tid for _, _, tid, _ in ClientFleet(8, 5.0, seed=3).take(
+                res["admitted"]
+            )
+        )
+        _wait_streams_cover(c, range(4), expect)
+        for i in range(4):
+            txns = _stream_txns(c, i)
+            assert len(txns) == len(set(txns)), f"dup commit on node {i}"
+            assert set(map(txn_id_of, txns)) == expect  # no loss either
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+        assert m.counters.get("traffic.mempool_overflow", 0) == 0
+        # the latency summary rides the same Prometheus dump
+        assert 'hbbft_summary{name="traffic.latency_s"' in m.prometheus_text()
+
+
+def test_open_loop_exactly_once_python():
+    _run_open_loop("python")
+
+
+def test_open_loop_exactly_once_native():
+    _lib_or_skip()
+    _run_open_loop("native")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: deterministic workload is byte-identical across arms
+# ---------------------------------------------------------------------------
+
+
+def test_deterministic_workload_byte_identical_across_arms():
+    _lib_or_skip()
+    streams = {}
+    for impl in ("python", "native"):
+        fleet = ClientFleet(6, 4.0, seed=11)
+        c = LocalCluster(4, seed=23, node_impl=impl)
+        d = TrafficDriver(c, fleet)
+        ids = d.run_presubmit(32)
+        assert len(ids) == 32
+        with c:
+            assert d.drain(EPOCH_TIMEOUT_S), d.outstanding()
+            _wait_streams_cover(c, range(4), set(ids))
+            keys = batch_keys(c, 0)
+            for i in (1, 2, 3):
+                other = batch_keys(c, i)
+                k = min(len(keys), len(other))
+                assert other[:k] == keys[:k]  # agreement inside the arm
+        # cut at the last batch that carries traffic (the arms race
+        # ahead by different numbers of trailing empty epochs)
+        last = max(
+            i for i, b in enumerate(c.batches(0))
+            if any(contrib for _, contrib in b.contributions)
+        )
+        streams[impl] = keys[: last + 1]
+    assert streams["python"] == streams["native"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: duplicate suppression under churn (kill/restart + resubmit)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_restart_resubmit_exactly_once():
+    """A client whose home node dies resubmits its in-flight
+    transactions to a survivor: after the restart the committed stream
+    still contains every admitted transaction EXACTLY once — the
+    resubmit path is covered by the cluster-wide committed window, and
+    the survivors are given time to resolve the dead node's last
+    proposals before the resubmit decision is taken."""
+    fleet = ClientFleet(8, 6.0, seed=13)
+    with LocalCluster(4, seed=31) as c:
+        d = TrafficDriver(c, fleet)
+        admitted = []
+
+        def offer(until_s):
+            t0 = time.monotonic()
+            while True:
+                el = time.monotonic() - t0
+                if el >= until_s:
+                    break
+                for _vt, cid, tid, txn in fleet.take_until(el, limit=500):
+                    if d._admit(cid, tid, txn, time.monotonic()):
+                        admitted.append(tid)
+                d.pace_all()
+                d.poll_commits()
+                time.sleep(0.02)
+
+        offer(1.0)
+        # park a few more transactions on node 3 and release them, so
+        # the kill strikes with real in-flight traffic to resubmit
+        extra = [a for a in fleet.take(64) if a[1] % 4 == 3][:6]
+        now = time.monotonic()
+        for _vt, cid, tid, txn in extra:
+            if d._admit(cid, tid, txn, now):
+                admitted.append(tid)
+        d.pace_all()
+        inflight = d.mempools[3].inflight_released()
+        assert inflight  # the drill is not vacuous
+        c.kill(3)
+        # let the survivors resolve any epoch the dead node's proposals
+        # were in flight for, THEN observe commits and resubmit
+        target = c.batch_count(0) + 3
+        assert c.wait(
+            lambda cl: min(cl.batch_count(i) for i in (0, 1, 2)) >= target,
+            EPOCH_TIMEOUT_S,
+        )
+        d.poll_commits()
+        d.resubmit_lost(3, 0)
+        c.restart(3)
+        assert d.drain(EPOCH_TIMEOUT_S), d.outstanding()
+        assert len(admitted) == len(set(admitted))
+        _wait_streams_cover(c, (0, 1, 2), set(admitted))
+        for i in (0, 1, 2):
+            txns = _stream_txns(c, i)
+            assert len(txns) == len(set(txns)), f"dup commit on node {i}"
+            assert set(map(txn_id_of, txns)) == set(admitted)
+        assert d.recorder.committed == len(admitted)
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
