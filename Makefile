@@ -39,4 +39,13 @@ traffic-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_traffic.py \
 		tests/test_metrics.py -q -m 'not slow'
 
-.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke
+# Byzantine chaos tier (ISSUE 7): live-socket adversary arms (crash/
+# equivocate/corrupt-share/replay/flood) on both node impls, composed
+# chaos schedules (Byzantine + WAN + kill/restart + partition/heal),
+# safety/liveness oracles, misbehavior accounting + escalating
+# reconnect bans.  No jax/XLA involvement — safe during crypto-cache
+# cold states; native halves skip cleanly without g++.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q -m 'not slow'
+
+.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke chaos-smoke

@@ -1,0 +1,210 @@
+"""Config 8: the Byzantine price — traffic over a cluster with f
+adversaries.
+
+Config 7 priced WAN links; this config prices ADVERSARIES: the same
+open-loop traffic plane (clients homed on honest nodes) over a cluster
+whose last f nodes run live-socket Byzantine strategies
+(hbbft_tpu.chaos), under clean and WAN link shapes, on both node
+arms.  Every line embeds the safety/liveness oracle verdicts — an
+epochs/s number over a cluster that silently diverged would be
+worthless — plus the misbehavior plane's totals (strikes, bans,
+rejected reconnects) so the defense's activity is visible next to the
+attack's.
+
+One JSON line per (N, profile):
+
+    BENCH_CHAOS_NS="4,10" BENCH_CHAOS_PROFILES="clean,wan" \
+        python benchmarks/config8_chaos.py
+    BENCH_CHAOS_IMPL=native python benchmarks/config8_chaos.py
+
+Strategy assignment (BENCH_CHAOS_STRATEGY): a single registry name
+puts that strategy on every Byzantine node; ``mixed`` (default) cycles
+corrupt-share / equivocate / flood across the f adversaries.
+
+Latency caveat: percentiles here are honest open-loop submit→commit
+numbers, but they include whatever the adversaries cost the honest
+quorum — compare against the same (N, profile) line of config7 to
+isolate the Byzantine price.
+
+Env: BENCH_CHAOS_NS (default "4,10"), BENCH_CHAOS_PROFILES (comma list
+of clean|wan|wan-lossy, default "clean,wan"), BENCH_CHAOS_IMPL
+(python|native, default python), BENCH_CHAOS_STRATEGY (registry name
+or "mixed"), BENCH_CHAOS_DURATION_S (default 2.0),
+BENCH_CHAOS_CLIENTS_PER_NODE (default 2), BENCH_CHAOS_TPS per client
+(default 80/N^2, the config7 scaling), BENCH_CHAOS_WAN_SCALE (default
+1.0), BENCH_CHAOS_SEED (default 0), BENCH_CHAOS_DEADLINE_S drain cap
+(default 120), BENCH_CHAOS_METRICS=1 embeds the metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.chaos import ChaosOracle  # noqa: E402
+from hbbft_tpu.traffic import ClientFleet, TrafficDriver  # noqa: E402
+from hbbft_tpu.transport import FaultInjector, LocalCluster  # noqa: E402
+from hbbft_tpu.transport.faults import wan_profile  # noqa: E402
+from hbbft_tpu.utils import serde  # noqa: E402
+
+from config6_tcp_cluster import preload_engine_serde  # noqa: E402
+
+_MIXED = ("corrupt-share", "equivocate", "flood")
+
+
+def byzantine_map(n: int, f: int, strategy: str) -> dict:
+    """The last f node ids run adversary arms (clients and oracle work
+    over the honest prefix)."""
+    ids = list(range(n - f, n))
+    if strategy == "mixed":
+        return {nid: _MIXED[k % len(_MIXED)] for k, nid in enumerate(ids)}
+    return {nid: strategy for nid in ids}
+
+
+def run_one(
+    n: int,
+    profile: str,
+    *,
+    impl: str,
+    strategy: str,
+    duration_s: float,
+    clients_per_node: int,
+    tps: float,
+    wan_scale: float,
+    seed: int,
+    deadline_s: float,
+) -> dict:
+    f = (n - 1) // 3
+    byz = byzantine_map(n, f, strategy)
+    injector = None
+    if profile != "clean":
+        injector = FaultInjector(
+            seed=seed + 1000, default=wan_profile(profile, scale=wan_scale)
+        )
+    honest = n - f
+    fleet = ClientFleet(clients_per_node * honest, tps, seed=seed)
+    rec = {
+        "config": "config8_chaos",
+        "nodes": n,
+        "num_byzantine": f,
+        "byzantine": {str(k): v for k, v in sorted(byz.items())},
+        "profile": profile,
+        "node_impl": impl,
+        "seed": seed,
+        "clients": clients_per_node * honest,
+        "offered_tps": round(fleet.offered_tps, 3),
+        "wan_scale": wan_scale,
+        "serde_native": serde._native_scan(serde.dumps(0)) is not None,
+    }
+    cluster = LocalCluster(
+        n, seed=seed, node_impl=impl, injector=injector, byzantine=byz
+    )
+    # home every client on an honest node: the adversaries still sit in
+    # consensus (that is the point), but no commit observation depends
+    # on a Byzantine mempool
+    d = TrafficDriver(cluster, fleet, assign=lambda cid: cid % honest)
+    oracle = ChaosOracle(cluster, driver=d)
+    try:
+        cluster.start()
+        res = d.run_open_loop(duration_s, drain_timeout_s=deadline_s)
+        wall = res["wall_s"]
+        epochs = min(cluster.batch_count(i) for i in oracle.honest_ids)
+        hist = d.recorder.hist
+        m = cluster.merged_metrics()
+        verdict: dict = {}
+        try:
+            verdict["safety_prefix"] = oracle.assert_safety()
+            verdict["safety"] = True
+        except AssertionError as exc:
+            verdict["safety"] = False
+            verdict["safety_error"] = str(exc)[:200]
+        try:
+            verdict["byzantine_faults_named"] = oracle.assert_attribution()
+            verdict["attribution"] = True
+        except AssertionError as exc:
+            verdict["attribution"] = False
+            verdict["attribution_error"] = str(exc)[:200]
+        try:
+            verdict["exactly_once"] = bool(
+                res["outstanding"] == 0 and oracle.assert_exactly_once() >= 0
+            )
+        except AssertionError as exc:
+            verdict["exactly_once"] = False
+            verdict["exactly_once_error"] = str(exc)[:200]
+        rec.update(
+            {
+                "wall_s": round(wall, 2),
+                "epochs_committed": epochs,
+                "epochs_per_s": round(epochs / wall, 3) if wall else None,
+                "arrived": res["arrived"],
+                "admitted": res["admitted"],
+                "committed_txns": res["committed"],
+                "txns_per_s": round(res["committed"] / wall, 1)
+                if wall
+                else None,
+                "outstanding": res["outstanding"],
+                "lat_p50_s": round(hist.quantile(0.5), 4),
+                "lat_p90_s": round(hist.quantile(0.9), 4),
+                "lat_p99_s": round(hist.quantile(0.99), 4),
+                "oracle": verdict,
+                "chaos": {
+                    k: v
+                    for k, v in sorted(m.counters.items())
+                    if k.startswith("chaos.")
+                },
+                "peer_misbehavior": m.counters.get(
+                    "transport.peer_misbehavior", 0
+                ),
+                "peer_bans": m.counters.get("transport.peer_bans", 0),
+                "ban_rejects": m.counters.get("transport.ban_rejects", 0),
+                "bad_payload": m.counters.get("cluster.bad_payload", 0),
+                "protocol_faults": m.counters.get("cluster.protocol_faults", 0),
+                "handler_errors": m.counters.get("cluster.handler_errors", 0),
+                "frames_shaped": injector.stats.shaped if injector else 0,
+                "complete": res["outstanding"] == 0,
+            }
+        )
+        if os.environ.get("BENCH_CHAOS_METRICS"):
+            rec["metrics"] = m.to_json()
+    finally:
+        cluster.stop()
+    return rec
+
+
+def main() -> None:
+    ns = [
+        int(x) for x in os.environ.get("BENCH_CHAOS_NS", "4,10").split(",")
+    ]
+    profiles = os.environ.get("BENCH_CHAOS_PROFILES", "clean,wan").split(",")
+    impl = os.environ.get("BENCH_CHAOS_IMPL", "python")
+    strategy = os.environ.get("BENCH_CHAOS_STRATEGY", "mixed")
+    duration = float(os.environ.get("BENCH_CHAOS_DURATION_S", "2.0"))
+    cpn = int(os.environ.get("BENCH_CHAOS_CLIENTS_PER_NODE", "2"))
+    tps_env = os.environ.get("BENCH_CHAOS_TPS")
+    wan_scale = float(os.environ.get("BENCH_CHAOS_WAN_SCALE", "1.0"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "0"))
+    deadline = float(os.environ.get("BENCH_CHAOS_DEADLINE_S", "120"))
+    preload_engine_serde()
+    for n in ns:
+        tps = float(tps_env) if tps_env else 80.0 / (n * n)
+        for profile in profiles:
+            rec = run_one(
+                n,
+                profile.strip(),
+                impl=impl,
+                strategy=strategy,
+                duration_s=duration,
+                clients_per_node=cpn,
+                tps=tps,
+                wan_scale=wan_scale,
+                seed=seed,
+                deadline_s=deadline,
+            )
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

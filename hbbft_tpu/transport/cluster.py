@@ -264,11 +264,50 @@ class LocalCluster:
         max_frame_len: Optional[int] = None,
         max_queue_frames: int = 20_000,
         node_impl: Any = "python",
+        byzantine: Optional[Dict[int, Any]] = None,
+        transport_kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.n = n
         self.seed = seed
         self.f = num_faulty if num_faulty is not None else (n - 1) // 3
-        assert 3 * self.f < n, f"need 3f < N (got N={n}, f={self.f})"
+        # A real error, not an assert: ``python -O`` strips asserts, and
+        # a cluster sized below the BFT bound silently voids every
+        # agreement guarantee downstream (the failure shows up later as
+        # an inexplicable stall or divergence, never here).
+        if self.f < 0 or n < 3 * self.f + 1:
+            raise ValueError(
+                f"BFT bound violated: need n >= 3*num_faulty + 1 "
+                f"(got n={n}, f={self.f})"
+            )
+        # byzantine (round 11): {node_id: strategy} — those nodes run
+        # live-socket adversary arms (hbbft_tpu.chaos) instead of honest
+        # ones.  A strategy is a registry name ("crash-stop" |
+        # "equivocate" | "corrupt-share" | "stale-replay" | "flood"), a
+        # ByzantineStrategy instance, or a zero-arg factory.  Byzantine
+        # nodes spend the fault budget: more than f of them voids the
+        # oracle's guarantees, so that is rejected too.
+        self.byzantine: Dict[int, Any] = dict(byzantine or {})
+        for nid in self.byzantine:
+            if not (0 <= nid < n):
+                raise ValueError(f"byzantine id {nid} outside 0..{n - 1}")
+        if len(self.byzantine) > self.f:
+            raise ValueError(
+                f"{len(self.byzantine)} Byzantine nodes exceed the fault "
+                f"budget f={self.f} (n={n})"
+            )
+        if self.byzantine:
+            # Fail on a bad registry name HERE, not after n listeners
+            # and a stack of node threads exist (there is no stop()
+            # path for a half-built cluster).  Instances/factories are
+            # resolved per-bind in _make_node as before.
+            from hbbft_tpu.chaos.strategies import STRATEGIES
+
+            for spec in self.byzantine.values():
+                if isinstance(spec, str) and spec not in STRATEGIES:
+                    raise ValueError(
+                        f"unknown Byzantine strategy {spec!r} "
+                        f"(known: {sorted(STRATEGIES)})"
+                    )
         self.suite = suite if suite is not None else ScalarSuite()
         self.cluster_id = cluster_id
         self.injector = injector
@@ -304,6 +343,8 @@ class LocalCluster:
         )
         if max_frame_len is not None:
             self._transport_kwargs["max_frame_len"] = max_frame_len
+        if transport_kwargs:
+            self._transport_kwargs.update(transport_kwargs)
 
         # Bind every listener first so the full address map exists
         # before any node is constructed.
@@ -331,12 +372,16 @@ class LocalCluster:
             return self._node_impl
         return self._node_impl.get(node_id, "python")
 
+    @property
+    def honest_ids(self) -> List[int]:
+        return [i for i in range(self.n) if i not in self.byzantine]
+
     def _make_node(self, i: int, t: TcpTransport):
         netinfo = build_netinfo(self.n, self.f, self.seed, self.suite, i)
         if self._impl_for(i) == "native":
             from hbbft_tpu.transport.native_node import NativeClusterNode
 
-            return NativeClusterNode(
+            node = NativeClusterNode(
                 node_id=i,
                 netinfo=netinfo,
                 all_ids=list(range(self.n)),
@@ -346,16 +391,33 @@ class LocalCluster:
                 batch_size=self._batch_size,
                 session_id=self._session_id,
             )
-        return ClusterNode(
-            node_id=i,
-            netinfo=netinfo,
-            all_ids=list(range(self.n)),
-            transport=t,
-            backend=self._backend_factory(self.suite),
-            suite=self.suite,
-            seed=self.seed,
-            protocol_factory=self._factory,
-        )
+        else:
+            node = ClusterNode(
+                node_id=i,
+                netinfo=netinfo,
+                all_ids=list(range(self.n)),
+                transport=t,
+                backend=self._backend_factory(self.suite),
+                suite=self.suite,
+                seed=self.seed,
+                protocol_factory=self._factory,
+            )
+        spec = self.byzantine.get(i)
+        if spec is not None:
+            # restart() re-enters here, so a reborn Byzantine node gets
+            # its strategy re-armed with fresh per-bind state
+            from hbbft_tpu.chaos.nodes import install_byzantine
+
+            node = install_byzantine(
+                node,
+                spec,
+                seed=self.seed,
+                suite=self.suite,
+                cluster_id=self.cluster_id,
+                peer_addrs={j: a for j, a in self.addr_map.items() if j != i},
+                impl=self._impl_for(i),
+            )
+        return node
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -450,6 +512,7 @@ class LocalCluster:
         target: int,
         timeout_s: float = 60.0,
         tag: str = "d",
+        tick: Optional[Callable[[], Any]] = None,
     ) -> None:
         """Feed txns to every live node until every node in ``ids`` has
         committed >= ``target`` batches; raises on timeout.
@@ -459,12 +522,20 @@ class LocalCluster:
         builds a transaction backlog that keeps committing epochs long
         after the target — the CLAUDE.md pacing invariant, held here
         ONCE for tests, benchmarks, and examples.
+
+        ``tick`` (optional) runs once per poll iteration — the chaos
+        scheduler pumps its timed fault events through it so a drive
+        and a fault schedule share one loop.
         """
         deadline = time.monotonic() + timeout_s
-        base = min(len(self.batches(i)) for i in ids)
+        # batch_count (O(1) under the node lock) not batches() — this
+        # poll fires every 50 ms and a list copy grows with the stream.
+        base = min(self.batch_count(i) for i in ids)
         k = 0
         while time.monotonic() < deadline:
-            mn = min(len(self.batches(i)) for i in ids)
+            if tick is not None:
+                tick()
+            mn = min(self.batch_count(i) for i in ids)
             if mn >= target:
                 return
             if k < (mn - base) + 3:
@@ -473,7 +544,7 @@ class LocalCluster:
                         self.submit(i, Input.user(f"{tag}-{k}-{i}"))
                 k += 1
             time.sleep(0.05)
-        counts = {i: len(self.batches(i)) for i in sorted(self.nodes)}
+        counts = {i: self.batch_count(i) for i in sorted(self.nodes)}
         raise TimeoutError(
             f"no progress to {target} batches within {timeout_s}s: {counts}"
         )

@@ -37,6 +37,12 @@ threshold-service deployments, PAPERS.md):
 * **Observability:** per-peer :class:`PeerStats` (bytes/frames in+out,
   queue depth, drops, reconnects, frame errors) exported into
   :class:`~hbbft_tpu.utils.metrics.Metrics` as counters + gauges.
+* **Misbehavior accounting (round 11):** frame-level violations on an
+  identified inbound connection charge the announced peer a strike;
+  every ``ban_threshold`` strikes earn a deterministic escalating
+  reconnect ban (:func:`ban_duration`), refusing the peer's HELLOs
+  until it lapses — a Byzantine peer can no longer corrupt one frame
+  per reconnect forever at zero cost.  Exported as ``peer.*`` gauges.
 
 Read-path safety: every ``recv`` is bounded by ``RECV_CHUNK`` and every
 received byte goes through a :class:`FrameDecoder` capped at
@@ -91,9 +97,52 @@ class PeerStats:
     reconnects: int = 0
     accepts: int = 0
     frame_errors: int = 0
+    # Byzantine accounting (round 11): protocol violations on an
+    # IDENTIFIED inbound connection (frame errors after a valid HELLO)
+    # are misbehavior strikes; every ``ban_threshold`` strikes earn an
+    # escalating reconnect ban, and HELLOs refused during a ban count
+    # as ban_rejects.  Without the ban, a peer could corrupt one frame
+    # per reconnect forever at zero cost (the corrupt-frame/reconnect
+    # loop): each violation costs only the attacker's own connection,
+    # which backoff restores in milliseconds.
+    misbehavior: int = 0
+    bans: int = 0
+    ban_rejects: int = 0
 
     def as_dict(self) -> Dict[str, int]:
         return dict(self.__dict__)
+
+
+def ban_duration(offense: int, base_s: float, cap_s: float) -> float:
+    """Length of a peer's ``offense``-th reconnect ban (0-based): pure
+    exponential escalation with NO jitter, so the schedule is a
+    deterministic function of the strike count alone — the chaos tier
+    pins this (seed-deterministic ban escalation).  The exponent is
+    clamped: 2.0**offense overflows float at offense >= 1024, and a
+    sustained corrupt-frame loop reaches that many bans in under an
+    hour — an OverflowError here would tear down the VICTIM's whole
+    transport loop (the attack the ban exists to price)."""
+    return min(cap_s, base_s * (2.0 ** min(offense, 64)))
+
+
+class _BanReject(FrameError):
+    """HELLO refused because the announced peer is under an active
+    reconnect ban.  A distinct type so the read path's FrameError
+    handler can close the connection WITHOUT counting a frame error:
+    ban rejects are the defense working, not channel corruption, and
+    conflating them would inflate ``transport.frame_errors`` by one
+    per refused redial for the whole ban window."""
+
+
+class _BanState:
+    """Per-peer misbehavior ledger (loop thread only)."""
+
+    __slots__ = ("strikes", "bans", "until")
+
+    def __init__(self) -> None:
+        self.strikes = 0   # violations since the last ban
+        self.bans = 0      # escalation level (total bans issued)
+        self.until = 0.0   # monotonic deadline of the active ban
 
 
 #: Outbound write-coalescing bound: frames are packed into the write
@@ -217,6 +266,9 @@ class TcpTransport:
         injector: Any = None,
         seed: int = 0,
         accept_unknown_peers: bool = False,
+        ban_threshold: int = 3,
+        ban_base_s: float = 0.25,
+        ban_cap_s: float = 2.0,
     ) -> None:
         self.node_id = node_id
         self.cluster_id = cluster_id
@@ -245,6 +297,16 @@ class TcpTransport:
         # ids.  True is for topologies where inbound peers are not known
         # up front (joining nodes); the in-process clusters never need it.
         self.accept_unknown_peers = accept_unknown_peers
+        # Misbehavior/ban policy (round 11).  ban_threshold <= 0
+        # disables banning (strikes are still counted).  The ban caps
+        # at ban_cap_s per offense, so an honest peer on a corrupting
+        # CHANNEL (injector corrupt_p) is delayed, never locked out —
+        # its dialer retries past the ban and the resume layer replays
+        # the clean originals (losslessness is test-pinned).
+        self.ban_threshold = ban_threshold
+        self.ban_base_s = ban_base_s
+        self.ban_cap_s = ban_cap_s
+        self._bans: Dict[Any, _BanState] = {}
         self._rng = random.Random(f"transport|{seed}|{node_id}")
         self._host = host
         self._sel = selectors.DefaultSelector()
@@ -400,6 +462,14 @@ class TcpTransport:
             m.gauge(f"{base}.frames_in", st.frames_in)
             m.gauge(f"{base}.reconnects", st.reconnects)
             m.gauge(f"{base}.frame_errors", st.frame_errors)
+            # peer.* misbehavior gauges (round 11): the <- direction
+            # marks these as judgements about INBOUND traffic from pid,
+            # exported next to the faults.* injector gauges so one
+            # Prometheus dump carries both sides of the Byzantine story
+            peer = f"peer.{self.node_id}<-{pid}"
+            m.gauge(f"{peer}.misbehavior", st.misbehavior)
+            m.gauge(f"{peer}.bans", st.bans)
+            m.gauge(f"{peer}.ban_rejects", st.ban_rejects)
         return m
 
     # -- event loop ----------------------------------------------------
@@ -807,26 +877,49 @@ class TcpTransport:
         try:
             conn.decoder.feed(data)
             burst: List[bytes] = []
-            for kind, payload in conn.decoder.frames():
+            # Parse + dispatch one frame at a time (NOT decoder.frames(),
+            # which would collect the whole burst before any dispatch):
+            # a violation mid-burst must not void the frames before it —
+            # in particular, a HELLO followed by a corrupt frame in the
+            # SAME recv must identify the peer first, so the violation
+            # is charged to its misbehavior account (round 11) instead
+            # of dying anonymously.
+            while True:
+                frame = conn.decoder.next_frame()
+                if frame is None:
+                    break
+                kind, payload = frame
                 if (
                     self.on_batch is not None
                     and conn.peer_id is not None
                     and kind == KIND_MSG
                 ):
-                    # Batch path: queue the whole read burst's MSG
-                    # frames for ONE consumer call.  Kind violations in
-                    # the same burst still raise below; frames batched
-                    # before the violation are simply never consumed or
-                    # acked (the resume layer covers them).
+                    # Batch path: queue the read burst's MSG frames for
+                    # ONE consumer call.  Kind violations in the same
+                    # burst still raise below; frames batched before
+                    # the violation are simply never consumed or acked
+                    # (the resume layer covers them).
                     burst.append(payload)
                     continue
                 self._handle_frame(conn, kind, payload)
             if burst:
                 self._dispatch_burst(conn, burst)
-        except FrameError:
+        except FrameError as exc:
+            if isinstance(exc, _BanReject):
+                # The defense firing, not a framing violation: counted
+                # as ban_rejects at the raise site, never frame_errors.
+                self._close_inbound(conn)
+                return
             self.metrics.count("transport.frame_errors")
             if conn.peer_id is not None:
                 self.peer_stats[conn.peer_id].frame_errors += 1
+                # A violation on an IDENTIFIED connection is this
+                # peer's misbehavior (a pre-HELLO violation has no one
+                # to charge).  Channel corruption is indistinguishable
+                # from Byzantine framing here by design — the ban is
+                # short either way, and resume keeps honest peers
+                # lossless across it.
+                self._note_misbehavior(conn.peer_id)
             self._close_inbound(conn)
             return
         except _ConsumerOverload:
@@ -852,6 +945,30 @@ class TcpTransport:
             conn.ack_timer = True
             self._add_timer(ACK_DELAY_S, "ack", conn)
 
+    # -- misbehavior accounting (round 11) -----------------------------
+    def _banned(self, pid: Any) -> bool:
+        b = self._bans.get(pid)
+        return b is not None and time.monotonic() < b.until
+
+    def _note_misbehavior(self, pid: Any) -> None:
+        """Charge one protocol-violation strike to ``pid``; every
+        ``ban_threshold`` strikes issue an escalating reconnect ban
+        (:func:`ban_duration` — deterministic, no jitter)."""
+        st = self.peer_stats[pid]
+        st.misbehavior += 1
+        self.metrics.count("transport.peer_misbehavior")
+        if self.ban_threshold <= 0:
+            return
+        b = self._bans.setdefault(pid, _BanState())
+        b.strikes += 1
+        if b.strikes >= self.ban_threshold:
+            b.strikes = 0
+            dur = ban_duration(b.bans, self.ban_base_s, self.ban_cap_s)
+            b.bans += 1
+            b.until = time.monotonic() + dur
+            st.bans = b.bans
+            self.metrics.count("transport.peer_bans")
+
     def _send_ack(self, conn: _Inbound) -> None:
         count = self._rx_counts[conn.peer_id]
         conn.last_ack = count
@@ -865,6 +982,15 @@ class TcpTransport:
             announced = decode_hello(payload, self.cluster_id)
             if announced not in self._out and not self.accept_unknown_peers:
                 raise FrameError(f"HELLO from unconfigured peer {announced!r}")
+            if self._banned(announced):
+                # Escalating reconnect ban: the peer's recent frame
+                # violations crossed ban_threshold, so its reconnects
+                # are refused until the ban lapses — the corrupt-frame/
+                # reconnect loop is no longer free.  A ban reject is
+                # NOT itself a strike (it would self-extend forever).
+                self.peer_stats[announced].ban_rejects += 1
+                self.metrics.count("transport.ban_rejects")
+                raise _BanReject(f"HELLO from banned peer {announced!r}")
             # A fresh HELLO supersedes any stale connection from the same
             # peer: close it WITHOUT consuming its buffered frames.  The
             # cumulative count is shared per peer id — draining a dead

@@ -1,0 +1,415 @@
+"""Byzantine chaos plane (ISSUE 7 acceptance surface).
+
+Live-socket adversary nodes over the untouched transport: every
+strategy in the catalog (crash-stop, equivocate, corrupt-share,
+stale-replay, flood) on BOTH ``node_impl`` arms at N=4 (f=1), a mixed
+three-adversary N=10 (f=3) cluster, a composed chaos schedule
+(Byzantine + WAN shape + kill/restart + partition/heal), traffic-plane
+exactly-once under an adversary, and the transport's misbehavior/ban
+plane (escalating reconnect bans priced deterministically, peer.*
+gauges, the >=12x corrupt-frame hammer).
+
+Budget on the 1-core box: every driven phase keeps the standard 45 s
+cap; the whole default tier is ~40-60 s warm (CLAUDE.md "chaos tier").
+No jax/XLA involvement — safe during crypto-cache cold states.  Native
+halves skip cleanly without a C++ toolchain.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from hbbft_tpu.chaos import (
+    ChaosOracle,
+    ChaosRunner,
+    CrashStop,
+    build_schedule,
+    tamper_payload,
+)
+from hbbft_tpu.chaos.oracle import (
+    batch_keys,
+    batches_sha,
+    fault_entries,
+    stream_txns,
+)
+from hbbft_tpu.chaos.strategies import EQUIVOCABLE_KINDS, SHARE_KINDS
+from hbbft_tpu.traffic import ClientFleet, TrafficDriver
+from hbbft_tpu.transport import (
+    KIND_MSG,
+    FaultInjector,
+    LocalCluster,
+    encode_frame,
+    encode_hello,
+    wan_profile,
+)
+from hbbft_tpu.transport.transport import ban_duration
+from hbbft_tpu.utils import serde
+
+EPOCH_TIMEOUT_S = 45  # wall cap per driven phase; typical is < 2 s
+
+STRATEGY_NAMES = [
+    "crash-stop", "equivocate", "corrupt-share", "stale-replay", "flood",
+]
+
+
+def _lib_or_skip():
+    from hbbft_tpu import native_engine
+
+    lib = native_engine.get_lib()
+    if lib is None:
+        pytest.skip("native engine unavailable (no compiler?)")
+    return lib
+
+
+# ---------------------------------------------------------------------------
+# satellite: construction-time BFT bound + fault-budget validation
+# ---------------------------------------------------------------------------
+
+
+def test_localcluster_validates_bft_bound():
+    """n >= 3*num_faulty + 1 is a constructor-time ValueError (a real
+    error, not an assert: -O must not turn the misconfiguration into a
+    silent downstream stall)."""
+    with pytest.raises(ValueError, match="BFT bound"):
+        LocalCluster(4, num_faulty=2)
+    with pytest.raises(ValueError, match="BFT bound"):
+        LocalCluster(6, num_faulty=2)  # needs 7
+    with pytest.raises(ValueError, match="BFT bound"):
+        LocalCluster(3, num_faulty=-1)
+    # exactly at the bound is fine (never started: no sockets driven)
+    LocalCluster(7, num_faulty=2)
+
+
+def test_localcluster_validates_byzantine_budget():
+    with pytest.raises(ValueError, match="fault budget"):
+        LocalCluster(4, byzantine={2: "flood", 3: "flood"})  # f=1
+    with pytest.raises(ValueError, match="outside"):
+        LocalCluster(4, byzantine={9: "flood"})
+    with pytest.raises(ValueError, match="unknown Byzantine strategy"):
+        with LocalCluster(4, byzantine={3: "no-such-strategy"}):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# misbehavior accounting + escalating reconnect bans
+# ---------------------------------------------------------------------------
+
+
+def test_ban_escalation_schedule_is_deterministic():
+    """The ban schedule is a pure function of the strike count — no
+    jitter, no rng: seed-determinism of the escalation by construction."""
+    assert [ban_duration(k, 0.25, 2.0) for k in range(5)] == [
+        0.25, 0.5, 1.0, 2.0, 2.0,
+    ]
+    assert ban_duration(0, 0.1, 0.4) == pytest.approx(0.1)
+    assert ban_duration(10, 0.1, 0.4) == pytest.approx(0.4)
+
+
+def test_corrupt_frame_ban_hammer_lossless():
+    """Satellite flake-hammer (>=12x): a peer identity that corrupts a
+    frame per reconnect gets charged a misbehavior strike each time and
+    banned on a deterministic escalation (bans == strikes // threshold),
+    while the REAL peer behind that identity stays lossless — the
+    corrupt-frame -> drop -> ACK-resume loop survives repetition and
+    is no longer free."""
+    with LocalCluster(
+        4, seed=21, transport_kwargs=dict(ban_base_s=0.1, ban_cap_s=0.4)
+    ) as c:
+        c.drive_to([0, 1, 2, 3], 1, timeout_s=EPOCH_TIMEOUT_S)
+        addr = c.addr_map[0]
+        cid = c.cluster_id
+        t = c.nodes[0].transport
+
+        def totals():
+            st = t.peer_stats[2]
+            return (st.misbehavior, st.ban_rejects)
+
+        for k in range(12):
+            before = totals()
+            frame = bytearray(encode_frame(KIND_MSG, b"hammer-%d" % k))
+            frame[9] ^= 0x10  # body bit flip: CRC fails at the decoder
+            with socket.create_connection(addr, timeout=5) as s:
+                s.sendall(encode_hello(2, cid) + bytes(frame))
+                s.settimeout(5)
+                try:
+                    while s.recv(64):
+                        pass
+                except OSError:
+                    pass
+            # each attempt is accounted as a strike (HELLO accepted,
+            # violation charged) or a ban reject (HELLO refused)
+            assert c.wait(lambda cl, b=before: totals() != b, 10), (k, before)
+        st = t.peer_stats[2]
+        assert st.misbehavior >= 3          # enough strikes to ban
+        assert st.bans == st.misbehavior // 3   # deterministic escalation
+        assert st.ban_rejects > 0           # the loop was actually priced
+        # losslessness: the REAL node 2 (same identity the attacker
+        # spoofed and got banned) catches up via dial-backoff + resume
+        c.drive_to(
+            [0, 1, 2, 3], len(c.batches(0)) + 2,
+            timeout_s=EPOCH_TIMEOUT_S, tag="after",
+        )
+        want = batch_keys(c, 0, upto=3)
+        for i in (1, 2, 3):
+            assert batch_keys(c, i, upto=3) == want
+        m = c.merged_metrics()
+        assert m.counters.get("transport.peer_misbehavior", 0) >= 3
+        assert m.counters.get("transport.peer_bans", 0) >= 1
+        assert m.counters.get("transport.ban_rejects", 0) >= 1
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+
+
+def test_peer_misbehavior_gauges_in_prometheus_dump():
+    """Satellite: the per-peer misbehavior counters ride the same
+    Prometheus dump as the transport and faults.* gauges."""
+    inj = FaultInjector(seed=1)
+    with LocalCluster(4, seed=27, injector=inj) as c:
+        c.drive_to([0, 1, 2, 3], 1, timeout_s=EPOCH_TIMEOUT_S)
+        # one identified violation at node 0, charged to peer 2
+        with socket.create_connection(c.addr_map[0], timeout=5) as s:
+            bad = bytearray(encode_frame(KIND_MSG, b"x"))
+            bad[9] ^= 1
+            s.sendall(encode_hello(2, c.cluster_id) + bytes(bad))
+            s.settimeout(5)
+            try:
+                while s.recv(64):
+                    pass
+            except OSError:
+                pass
+        assert c.wait(
+            lambda cl: cl.nodes[0].transport.peer_stats[2].misbehavior >= 1,
+            10,
+        )
+        text = c.merged_metrics().prometheus_text()
+        assert 'hbbft_gauge{name="peer.0<-2.misbehavior"} 1' in text
+        assert 'name="peer.0<-2.bans"' in text
+        assert 'name="peer.0<-2.ban_rejects"' in text
+        assert 'name="faults.dropped"' in text  # alongside round-10 gauges
+
+
+# ---------------------------------------------------------------------------
+# Byzantine strategy arms: every strategy, both node impls, N=4 f=1
+# ---------------------------------------------------------------------------
+
+#: per-strategy activity counter the run must have moved (a drill that
+#: never fired its behavior is vacuous)
+_ACTIVITY = {
+    "crash-stop": "chaos.crash_stopped",
+    "equivocate": "chaos.equivocated",
+    "corrupt-share": "chaos.tampered_shares",
+    "stale-replay": "chaos.replayed",
+    "flood": "chaos.garbage_payloads",
+}
+
+
+def _run_byzantine(impl: str, name: str, seed: int = 29):
+    spec = (lambda: CrashStop(after_s=0.3)) if name == "crash-stop" else name
+    with LocalCluster(4, seed=seed, node_impl=impl, byzantine={3: spec}) as c:
+        o = ChaosOracle(c)
+        o.assert_progress(extra=2, timeout_s=EPOCH_TIMEOUT_S)
+        if name == "crash-stop":
+            # drive past the crash deadline, then require further
+            # commits from the honest trio alone
+            time.sleep(0.4)
+            o.assert_progress(extra=2, timeout_s=EPOCH_TIMEOUT_S, tag="post")
+        k = o.assert_safety()
+        named = o.assert_attribution()
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+        assert m.counters.get(_ACTIVITY[name], 0) > 0, name
+        if name == "corrupt-share":
+            # the share plane detected AND attributed the adversary
+            assert named > 0
+            kinds = {
+                kind
+                for i in o.honest_ids
+                for _s, kind in fault_entries(c.nodes[i])
+            }
+            assert any("invalid-share" in kd for kd in kinds), kinds
+        if name == "flood":
+            assert m.counters.get("cluster.bad_payload", 0) > 0
+        return k
+
+
+def test_byzantine_strategies_python_arm():
+    """Every strategy against Python nodes: honest trio commits
+    byte-identical batches, faults name only the adversary."""
+    for name in STRATEGY_NAMES:
+        assert _run_byzantine("python", name) >= 2, name
+
+
+def test_byzantine_strategies_native_arm():
+    """Every strategy against native-engine nodes (corrupt-share runs
+    through the engine tamper hooks)."""
+    _lib_or_skip()
+    for name in STRATEGY_NAMES:
+        assert _run_byzantine("native", name) >= 2, name
+
+
+# ---------------------------------------------------------------------------
+# N=10, f=3: three different adversaries at once, both arms
+# ---------------------------------------------------------------------------
+
+
+def _run_mixed_n10(impl: str):
+    byz = {7: "corrupt-share", 8: "equivocate", 9: "flood"}
+    with LocalCluster(10, seed=41, node_impl=impl, byzantine=byz) as c:
+        o = ChaosOracle(c)
+        o.assert_progress(extra=2, timeout_s=EPOCH_TIMEOUT_S)
+        assert o.assert_safety() >= 2
+        assert o.assert_attribution() > 0  # the adversaries were named
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+        for name in ("corrupt-share", "equivocate", "flood"):
+            assert m.counters.get(_ACTIVITY[name], 0) > 0, name
+        return batches_sha(c, 0, upto=2)
+
+
+def test_mixed_byzantine_n10_f3_python():
+    assert _run_mixed_n10("python")
+
+
+def test_mixed_byzantine_n10_f3_native():
+    _lib_or_skip()
+    assert _run_mixed_n10("native")
+
+
+# ---------------------------------------------------------------------------
+# composed chaos: Byzantine + WAN shape + kill/restart + partition/heal
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_schedule_is_seed_deterministic():
+    a = build_schedule(5, [3], 3.0, outage=True)
+    b = build_schedule(5, [3], 3.0, outage=True)
+    assert a == b
+    assert build_schedule(6, [3], 3.0, outage=True) != a
+    kinds = [e.kind for e in a]
+    assert kinds.index("kill") < kinds.index("restart")
+    assert kinds.index("partition") < kinds.index("heal")
+    assert all(e.node == 3 for e in a)  # disruption targets stay Byzantine
+    assert all(0.0 <= e.at_s <= 3.0 for e in a)
+
+
+def _run_composed(impl: str):
+    inj = FaultInjector(seed=9, default=wan_profile("wan", scale=0.2))
+    c = LocalCluster(
+        4, seed=53, node_impl=impl, byzantine={3: "corrupt-share"},
+        injector=inj,
+    )
+    sched = build_schedule(seed=7, byzantine_ids=[3], duration_s=3.0)
+    runner = ChaosRunner(c, sched, injector=inj)
+    with c:
+        o = ChaosOracle(c)
+        runner.start()
+        while runner.pump():  # keep committing THROUGH the event window
+            o.assert_progress(
+                extra=1, timeout_s=EPOCH_TIMEOUT_S, tick=runner.pump,
+                tag="chaos",
+            )
+        runner.drain()
+        o.assert_progress(extra=2, timeout_s=EPOCH_TIMEOUT_S, tag="post")
+        assert o.assert_safety() >= 3
+        o.assert_attribution()
+        fired = {e.kind for e in runner.fired}
+        assert fired >= {"kill", "restart", "partition", "heal"}
+        assert inj.stats.shaped > 0       # the WAN shape was live
+        assert inj.stats.partitioned > 0  # the partition window bit
+        m = c.merged_metrics()
+        assert m.counters.get("cluster.handler_errors", 0) == 0
+
+
+def test_composed_chaos_schedule_python():
+    _run_composed("python")
+
+
+def test_composed_chaos_schedule_native():
+    _lib_or_skip()
+    _run_composed("native")
+
+
+# ---------------------------------------------------------------------------
+# traffic plane under an adversary: exactly-once end to end
+# ---------------------------------------------------------------------------
+
+
+def test_traffic_exactly_once_with_byzantine_node():
+    """Open-loop clients homed on the honest trio while node 3 corrupts
+    its shares: every admitted transaction commits exactly once on
+    every honest node, and the latency clock closes for all of them."""
+    fleet = ClientFleet(6, 4.0, seed=5)
+    with LocalCluster(4, seed=59, byzantine={3: "corrupt-share"}) as c:
+        d = TrafficDriver(c, fleet, assign=lambda cid: cid % 3)
+        res = d.run_open_loop(1.5, drain_timeout_s=EPOCH_TIMEOUT_S)
+        assert res["outstanding"] == 0, res
+        assert res["committed"] == res["admitted"] > 0
+        o = ChaosOracle(c, driver=d)
+        expect = {
+            tid
+            for _, _, tid, _ in ClientFleet(6, 4.0, seed=5).take(
+                res["admitted"]
+            )
+        }
+        assert c.wait(
+            lambda cl: all(
+                expect <= o.committed_ids(i) for i in o.honest_ids
+            ),
+            EPOCH_TIMEOUT_S,
+        )
+        assert o.assert_exactly_once() == res["committed"]
+        for i in o.honest_ids:
+            assert {t.split("#", 1)[0] for t in stream_txns(c, i)} == expect
+        o.assert_safety()
+        o.assert_attribution()
+
+
+# ---------------------------------------------------------------------------
+# strategy unit seams: tamper_payload variants are valid wire traffic
+# ---------------------------------------------------------------------------
+
+
+def test_tamper_payload_variants_decode_and_differ():
+    """An equivocation/corrupt-share variant must re-encode as VALID
+    wire traffic (well-formed, wrong contents) and differ from the
+    original; non-SqMessage payloads and untargeted flavors map to
+    None.  (No new serde tags anywhere: the chaos plane only emits
+    existing registered wire structs or deliberately-invalid bytes,
+    so the HBT005 wire-tag classification is unchanged.)"""
+    import random as _random
+
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.protocols.sender_queue import SqMessage
+
+    suite = ScalarSuite()
+    # harvest live traffic from a tiny run
+    corpus = []
+    with LocalCluster(4, seed=3) as c:
+        node = c.nodes[1]
+        orig = node.transport.send
+
+        def send(dest, payload, _o=orig):
+            corpus.append(payload)
+            return _o(dest, payload)
+
+        node.transport.send = send
+        c.drive_to([0, 1, 2, 3], 1, timeout_s=EPOCH_TIMEOUT_S)
+    rng = _random.Random(17)
+    changed = 0
+    for payload in sorted(set(corpus)):
+        v = tamper_payload(
+            payload, rng, suite, EQUIVOCABLE_KINDS | SHARE_KINDS
+        )
+        if v is None:
+            continue
+        changed += 1
+        assert v != payload
+        m = serde.try_loads(v, suite=suite)
+        assert isinstance(m, SqMessage)  # valid wire traffic
+    assert changed > 5  # a real epoch carries plenty of targeted flavors
+    assert tamper_payload(serde.dumps(7), rng, suite, SHARE_KINDS) is None
+    # epoch announces carry no targeted leaves -> untouched
+    ann = serde.dumps(SqMessage.epoch_started((0, 1)))
+    assert tamper_payload(ann, rng, suite, EQUIVOCABLE_KINDS) is None

@@ -173,6 +173,46 @@ node.ingest([1, 2, 99, 0, 2], batch)  # 99 out of range, 0 = local: both bad
 node.run()
 assert node.stats()["bad_payload"] >= 2, node.stats()
 print("SANITIZED-WIRE-OK")
+
+# Round 11: one mixed good/equivocating/corrupt ingest batch.  The
+# chaos plane's equivocation/corrupt-share variants are VALID wire
+# traffic (TamperingAdversary rewrites re-encoded over the same serde
+# grammar) — the decoder must classify and ingest them interleaved with
+# corrupt and truncated frames without the sanitizer noticing anything.
+from hbbft_tpu.chaos.strategies import (
+    EQUIVOCABLE_KINDS, SHARE_KINDS, tamper_payload,
+)
+
+rng11 = _wrng.Random(11)
+node3 = NativeNodeEngine(
+    0, build_netinfo(4, 1, 0, _suite, 0), seed=0, batch_size=3,
+    session_id=b"san-chaos",
+)
+node3.handle_input(Input.user("chaos-tx"))
+node3.run()
+frames3 = []
+node3.drain_egress(lambda d, p: frames3.append(p))
+variants = []
+for p in frames3:
+    v = tamper_payload(p, rng11, _suite, EQUIVOCABLE_KINDS | SHARE_KINDS)
+    if v is not None:
+        variants.append(v)
+assert variants, "no equivocable egress traffic produced"
+for v in variants:
+    assert int(wl.hbe_wire_classify(v, len(v))) > 0, "variant rejected"
+good = frames3[0]
+corrupt = bytes([good[0] ^ 0xFF]) + good[1:]
+mixed = [
+    good,
+    variants[0],
+    corrupt,
+    variants[-1][: max(1, len(variants[-1]) // 2)],
+    variants[0] + b"\\x00",  # trailing garbage: reject path
+]
+node3.ingest([1, 2, 3, 1, 2], mixed)
+node3.run()
+assert node3.stats()["handled"] >= 2, node3.stats()
+print("SANITIZED-CHAOS-OK")
 """
 
 
@@ -235,6 +275,7 @@ def test_asan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "AddressSanitizer" not in res.stderr
 
 
@@ -245,6 +286,7 @@ def test_ubsan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "runtime error" not in res.stderr
 
 
