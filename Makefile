@@ -48,4 +48,14 @@ traffic-smoke:
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q -m 'not slow'
 
-.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke chaos-smoke
+# Flight-recorder tier (ISSUE 9): trace rings on both node arms, Chrome
+# trace export + phase spans, Prometheus exposition grammar, live
+# /metrics /trace.json /healthz scrape against a driven cluster.  No
+# jax/XLA involvement — safe during crypto-cache cold states; the
+# native-arm halves skip cleanly without g++.
+obs-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py \
+		tests/test_metrics.py -q -m 'not slow'
+
+.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
+	chaos-smoke obs-smoke

@@ -22,9 +22,18 @@ cross-arm digests are NOT comparable in that mode — pacing races).
 
 Env: BENCH_TCP_NS (comma list, default "4,8,16"), BENCH_TCP_EPOCHS
 (target epochs per N, default 5), BENCH_TCP_DEADLINE_S per N (default
-300), BENCH_TCP_IMPL (python|native, default python), BENCH_TCP_DRIVE
-(presubmit|paced, default presubmit), BENCH_TCP_SEED (default 0),
-BENCH_TCP_METRICS=1 to embed the merged metrics snapshot.
+300), BENCH_TCP_IMPL (python|native|mixed, default python; "mixed"
+alternates arms per node id — one flight-recorder trace then carries
+tracks from BOTH impls), BENCH_TCP_DRIVE (presubmit|paced, default
+presubmit), BENCH_TCP_SEED (default 0), BENCH_TCP_METRICS=1 to embed
+the merged metrics snapshot.
+
+Flight recorder (round 12): BENCH_TRACE=<dir> writes the merged Chrome
+trace (one file per line, path echoed in the JSON) — load it in
+Perfetto / chrome://tracing; BENCH_OBS_PORT=<port> serves /metrics,
+/trace.json and /healthz live during the run (port echoed too; 0 picks
+a free one).  Native arms always carry their engine.cyc.<type> cycle
+splits in the JSON line.
 """
 
 from __future__ import annotations
@@ -62,11 +71,48 @@ def preload_engine_serde() -> bool:
     return serde._native_scan(serde.dumps(0)) is not None
 
 
+def resolve_impl(impl: str, n: int):
+    """"mixed" = alternate node arms (even ids python, odd native), so
+    one cluster/trace carries both impls."""
+    if impl == "mixed":
+        return {i: "native" if i % 2 else "python" for i in range(n)}
+    return impl
+
+
+def obs_extras(rec: dict, cluster, name: str, m=None) -> None:
+    """Shared round-12 benchmark plumbing: engine cycle splits on every
+    line, BENCH_TRACE=<dir> Chrome-trace dump, BENCH_OBS_PORT scrape
+    endpoints (started by the caller right after cluster.start()).
+    Pass the caller's merged-metrics snapshot via ``m`` so the JSON
+    line's fields all come from ONE instant (and the merge+ring walk
+    runs once per line)."""
+    if m is None:
+        m = cluster.merged_metrics(fresh=True)
+    cyc = {
+        k.split(".", 2)[2]: v
+        for k, v in sorted(m.counters.items())
+        if k.startswith("engine.cyc.")
+    }
+    if cyc:
+        rec["engine_cyc"] = cyc
+    sm = m.summaries.get("epoch.latency")
+    if sm is not None:
+        rec["epoch_lat_p50_s"] = round(sm.quantiles.get(0.5, 0.0), 4)
+        rec["epoch_lat_p99_s"] = round(sm.quantiles.get(0.99, 0.0), 4)
+    trace_dir = os.environ.get("BENCH_TRACE")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"{name}.trace.json")
+        rec["trace_file"] = cluster.write_trace(path)
+
+
 def run_n(
     n: int, epochs: int, deadline_s: float, impl: str, drive: str, seed: int
 ) -> dict:
     t0 = time.perf_counter()
-    cluster = LocalCluster(n, seed=seed, batch_size=8, node_impl=impl)
+    cluster = LocalCluster(
+        n, seed=seed, batch_size=8, node_impl=resolve_impl(impl, n)
+    )
     setup_s = time.perf_counter() - t0
     rec = {
         "config": "config6_tcp_cluster",
@@ -91,6 +137,9 @@ def run_n(
     t0 = time.perf_counter()
     try:
         cluster.start()
+        obs_port = os.environ.get("BENCH_OBS_PORT")
+        if obs_port is not None:
+            rec["obs_port"] = cluster.serve_obs(port=int(obs_port)).port
         try:
             if drive == "presubmit":
                 ok = cluster.wait(
@@ -110,7 +159,7 @@ def run_n(
         digest = hashlib.sha256()
         for b in cluster.batches(0)[:epochs]:
             digest.update(serde.dumps((b.era, b.epoch, b.contributions)))
-        m = cluster.merged_metrics()
+        m = cluster.merged_metrics(fresh=True)
         frames = sum(
             st["frames_out"]
             for node in cluster.nodes.values()
@@ -140,6 +189,7 @@ def run_n(
         )
         if os.environ.get("BENCH_TCP_METRICS"):
             rec["metrics"] = m.to_json()
+        obs_extras(rec, cluster, f"config6_n{n}_{impl}", m=m)
     finally:
         cluster.stop()
     return rec

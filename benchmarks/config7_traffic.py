@@ -32,9 +32,14 @@ loss/dup only on ONE node's links — inside the f-tolerance envelope;
 clients are homed on the survivors, so the run measures the cluster
 serving traffic while carrying a degraded member).
 
+Flight recorder (round 12): BENCH_TRACE=<dir> writes the merged Chrome
+trace per line; BENCH_OBS_PORT serves live /metrics + /trace.json +
+/healthz; every line carries epoch_lat_p50_s/p99 from the
+EpochTracker-fed epoch.latency summary.
+
 Env: BENCH_TRAFFIC_NS (default "4,8,16"), BENCH_TRAFFIC_PROFILES
 (comma list of clean|wan|wan-lossy|faulty, default "clean,wan"),
-BENCH_TRAFFIC_IMPL (python|native, default python),
+BENCH_TRAFFIC_IMPL (python|native|mixed, default python),
 BENCH_TRAFFIC_DRIVE (open|presubmit), BENCH_TRAFFIC_DURATION_S
 (default 2.0), BENCH_TRAFFIC_TXNS (presubmit workload, default 32),
 BENCH_TRAFFIC_CLIENTS_PER_NODE (default 2), BENCH_TRAFFIC_TPS
@@ -64,7 +69,11 @@ from hbbft_tpu.transport import FaultInjector, LocalCluster  # noqa: E402
 from hbbft_tpu.transport.faults import wan_profile  # noqa: E402
 from hbbft_tpu.utils import serde  # noqa: E402
 
-from config6_tcp_cluster import preload_engine_serde  # noqa: E402
+from config6_tcp_cluster import (  # noqa: E402
+    obs_extras,
+    preload_engine_serde,
+    resolve_impl,
+)
 
 
 def build_injector(profile, n, seed, scale):
@@ -119,7 +128,9 @@ def run_one(
         "wan_scale": wan_scale,
         "serde_native": serde._native_scan(serde.dumps(0)) is not None,
     }
-    cluster = LocalCluster(n, seed=seed, node_impl=impl, injector=injector)
+    cluster = LocalCluster(
+        n, seed=seed, node_impl=resolve_impl(impl, n), injector=injector
+    )
     # faulty profile: home every client on a survivor — the degraded
     # node still participates in consensus (that's the point) but no
     # txn's commit observation depends on its lossy links staying live
@@ -129,6 +140,9 @@ def run_one(
         assign = lambda cid: cid % (n - 1)  # noqa: E731
     d = TrafficDriver(cluster, fleet, assign=assign)
     try:
+        obs_port = os.environ.get("BENCH_OBS_PORT")
+        if obs_port is not None:
+            rec["obs_port"] = cluster.serve_obs(port=int(obs_port)).port
         if drive == "presubmit":
             ids = d.run_presubmit(txns)
             rec["presubmitted"] = len(ids)
@@ -156,9 +170,14 @@ def run_one(
                 duration_s, drain_timeout_s=deadline_s
             )
             wall = res["wall_s"]
-        epochs = min(len(cluster.batches(i)) for i in cluster.nodes)
+        # Epoch accounting now comes from the EpochTracker wired into
+        # both node impls (round 12): min finished-count across nodes
+        # replaces the ad-hoc batches() length math, and the commit
+        # latency distribution rides in merged_metrics()'s
+        # epoch.latency summary (obs_extras exports its p50/p99).
+        epochs = min(cluster.batch_count(i) for i in cluster.nodes)
         hist = d.recorder.hist
-        m = cluster.merged_metrics()
+        m = cluster.merged_metrics(fresh=True)
         rec.update(
             {
                 "wall_s": round(wall, 2),
@@ -188,6 +207,7 @@ def run_one(
         )
         if os.environ.get("BENCH_TRAFFIC_METRICS"):
             rec["metrics"] = m.to_json()
+        obs_extras(rec, cluster, f"config7_n{n}_{profile}_{impl}", m=m)
     finally:
         cluster.stop()
     return rec

@@ -26,9 +26,15 @@ numbers, but they include whatever the adversaries cost the honest
 quorum — compare against the same (N, profile) line of config7 to
 isolate the Byzantine price.
 
+Flight recorder (round 12): BENCH_TRACE=<dir> writes the run's merged
+Chrome trace (the Byzantine disruption window and the honest nodes'
+recovery are visible per node track); BENCH_OBS_PORT serves /metrics,
+/trace.json, /healthz live; BENCH_CHAOS_IMPL=mixed alternates node
+arms so one trace carries both impls.
+
 Env: BENCH_CHAOS_NS (default "4,10"), BENCH_CHAOS_PROFILES (comma list
 of clean|wan|wan-lossy, default "clean,wan"), BENCH_CHAOS_IMPL
-(python|native, default python), BENCH_CHAOS_STRATEGY (registry name
+(python|native|mixed, default python), BENCH_CHAOS_STRATEGY (registry name
 or "mixed"), BENCH_CHAOS_DURATION_S (default 2.0),
 BENCH_CHAOS_CLIENTS_PER_NODE (default 2), BENCH_CHAOS_TPS per client
 (default 80/N^2, the config7 scaling), BENCH_CHAOS_WAN_SCALE (default
@@ -50,7 +56,11 @@ from hbbft_tpu.transport import FaultInjector, LocalCluster  # noqa: E402
 from hbbft_tpu.transport.faults import wan_profile  # noqa: E402
 from hbbft_tpu.utils import serde  # noqa: E402
 
-from config6_tcp_cluster import preload_engine_serde  # noqa: E402
+from config6_tcp_cluster import (  # noqa: E402
+    obs_extras,
+    preload_engine_serde,
+    resolve_impl,
+)
 
 _MIXED = ("corrupt-share", "equivocate", "flood")
 
@@ -100,7 +110,11 @@ def run_one(
         "serde_native": serde._native_scan(serde.dumps(0)) is not None,
     }
     cluster = LocalCluster(
-        n, seed=seed, node_impl=impl, injector=injector, byzantine=byz
+        n,
+        seed=seed,
+        node_impl=resolve_impl(impl, n),
+        injector=injector,
+        byzantine=byz,
     )
     # home every client on an honest node: the adversaries still sit in
     # consensus (that is the point), but no commit observation depends
@@ -109,11 +123,14 @@ def run_one(
     oracle = ChaosOracle(cluster, driver=d)
     try:
         cluster.start()
+        obs_port = os.environ.get("BENCH_OBS_PORT")
+        if obs_port is not None:
+            rec["obs_port"] = cluster.serve_obs(port=int(obs_port)).port
         res = d.run_open_loop(duration_s, drain_timeout_s=deadline_s)
         wall = res["wall_s"]
         epochs = min(cluster.batch_count(i) for i in oracle.honest_ids)
         hist = d.recorder.hist
-        m = cluster.merged_metrics()
+        m = cluster.merged_metrics(fresh=True)
         verdict: dict = {}
         try:
             verdict["safety_prefix"] = oracle.assert_safety()
@@ -169,6 +186,7 @@ def run_one(
         )
         if os.environ.get("BENCH_CHAOS_METRICS"):
             rec["metrics"] = m.to_json()
+        obs_extras(rec, cluster, f"config8_n{n}_{profile}_{impl}", m=m)
     finally:
         cluster.stop()
     return rec

@@ -144,6 +144,11 @@ class ChaosRunner:
 
     def _fire(self, e: ChaosEvent) -> None:
         c = self.cluster
+        # Flight recorder: chaos disruptions land on the cluster track so
+        # the trace shows the fault window next to the nodes' recovery.
+        buf = getattr(c, "trace", None)
+        if buf is not None:
+            buf.emit(f"chaos.{e.kind}", node=e.node, at_s=e.at_s)
         if e.kind == "kill":
             c.kill(e.node)
         elif e.kind == "restart":

@@ -312,6 +312,15 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_node_egress_drain.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
     lib.hbe_node_stat.restype = ctypes.c_uint64
     lib.hbe_node_stat.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    # flight recorder (round 12): bounded milestone event ring
+    lib.hbe_trace_enable.restype = None
+    lib.hbe_trace_enable.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.hbe_trace_drain.restype = ctypes.c_int64
+    lib.hbe_trace_drain.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.hbe_trace_pending.restype = ctypes.c_uint64
+    lib.hbe_trace_pending.argtypes = [ctypes.c_void_p]
+    lib.hbe_trace_dropped.restype = ctypes.c_uint64
+    lib.hbe_trace_dropped.argtypes = [ctypes.c_void_p]
     lib.hbe_wire_classify.restype = ctypes.c_int32
     lib.hbe_wire_classify.argtypes = [cp, ctypes.c_uint64]
     lib.hbe_wire_roundtrip.restype = ctypes.c_int64
@@ -691,6 +700,72 @@ class _EngineNetBase:
                 "cycles": int(lib.hbe_prof_cycles(h, slot)),
                 "count": int(lib.hbe_prof_count(h, slot)),
             }
+        return out
+
+    # Engine TraceKind values (native/engine.cpp enum TraceKind) -> the
+    # shared milestone taxonomy (docs/OBSERVABILITY.md).  d packs
+    # (round << 1) | value for coin/decide records.
+    TRACE_KIND_NAMES = {
+        1: "epoch.open",
+        2: "epoch.commit",
+        3: "rbc.value",
+        4: "rbc.ready",
+        5: "rbc.deliver",
+        6: "ba.round",
+        7: "ba.coin",
+        8: "ba.decide",
+        9: "decrypt.start",
+        10: "decrypt.done",
+    }
+
+    def enable_trace(self, capacity: int = 8192) -> None:
+        """Enable the engine's bounded milestone event ring (0 turns it
+        off).  Emitting is allocation-free; drain with
+        :meth:`drain_trace` (owner thread only, like every engine
+        call)."""
+        self.lib.hbe_trace_enable(self.handle, capacity)
+
+    @property
+    def trace_dropped(self) -> int:
+        return int(self.lib.hbe_trace_dropped(self.handle))
+
+    def drain_trace(self) -> List[Any]:
+        """Drain engine trace records into :class:`~hbbft_tpu.obs.trace.
+        TraceEvent`s (ns stamps -> float wall seconds; kind/abcd -> the
+        taxonomy's named args)."""
+        import struct
+
+        from hbbft_tpu.obs.trace import TraceEvent
+
+        lib = self.lib
+        pending = int(lib.hbe_trace_pending(self.handle))
+        if not pending:
+            return []
+        buf = (ctypes.c_uint8 * (32 * pending))()
+        nrec = int(lib.hbe_trace_drain(self.handle, buf, len(buf)))
+        out: List[Any] = []
+        raw = bytes(buf)
+        for i in range(max(nrec, 0)):
+            ts_ns, node, kind, a, b, c, d = struct.unpack_from(
+                "<q6i", raw, 32 * i
+            )
+            name = self.TRACE_KIND_NAMES.get(kind)
+            if name is None:  # future-proof: unknown kinds still surface
+                name, args = f"engine.k{kind}", {"a": a, "b": b, "c": c, "d": d}
+            else:
+                args = {"node": node, "era": a, "epoch": b}
+                if name.startswith(("rbc.", "decrypt.")):
+                    args["proposer"] = c
+                elif name == "ba.round":
+                    args["proposer"] = c
+                    args["round"] = d
+                elif name in ("ba.coin", "ba.decide"):
+                    args["proposer"] = c
+                    args["round"] = d >> 1
+                    args["value"] = d & 1
+                elif name == "epoch.commit":
+                    args["contribs"] = c
+            out.append(TraceEvent(ts_ns / 1e9, name, args))
         return out
 
     def _raise_cb_error(self) -> None:
@@ -1338,6 +1413,7 @@ class NativeNodeEngine(_EngineNetBase):
         subset_handling: str = "incremental",
         suite: Optional[Suite] = None,
         rlc: Optional[bool] = None,
+        trace_capacity: int = 8192,
     ) -> None:
         n = len(netinfo.all_ids)
         lib = get_lib(_words_for(n))
@@ -1363,6 +1439,11 @@ class NativeNodeEngine(_EngineNetBase):
         if rlc is not None:
             lib.hbe_set_rlc(self.handle, 1 if rlc else 0)
         lib.hbe_set_local(self.handle, node_id, self.SQ_WINDOW)
+        # Flight recorder (round 12): default-on for cluster nodes —
+        # milestone-rate emits into a preallocated ring, drained by the
+        # runtime once per sweep (trace_capacity=0 disables).
+        if trace_capacity:
+            self.enable_trace(trace_capacity)
         # keep callback objects alive for the engine's lifetime
         self._batch_cb = _BATCH_CB(self._on_batch)
         self._contrib_cb = _CONTRIB_CB(self._on_contrib)

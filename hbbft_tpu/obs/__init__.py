@@ -1,0 +1,49 @@
+"""Flight recorder (round 12): cross-node epoch tracing + live scrape.
+
+Three pieces, usable separately:
+
+* :mod:`hbbft_tpu.obs.trace` — a bounded per-node ring of structured
+  protocol events (:class:`TraceBuffer`) plus the thread-local tracer
+  the Python protocol modules emit through (a no-op when no tracer is
+  installed, so VirtualNet simulations and unit tests pay one attribute
+  lookup per milestone).
+* :mod:`hbbft_tpu.obs.export` — merges per-node rings on the shared
+  wall clock into Chrome trace-event JSON (one track per node, derived
+  spans per epoch phase) and per-epoch phase-latency summaries.
+* :mod:`hbbft_tpu.obs.server` — a stdlib-HTTP scrape server serving
+  ``/metrics`` (Prometheus exposition), ``/trace.json`` and
+  ``/healthz`` for a live :class:`~hbbft_tpu.transport.cluster.
+  LocalCluster` (usable mid-run — every read path snapshots).
+
+The native arm's events come from a bounded event log inside
+``native/engine.cpp`` drained one ctypes call per sweep
+(``hbe_trace_drain``); both arms share the event taxonomy documented in
+docs/OBSERVABILITY.md.
+
+Re-exports resolve LAZILY (PEP 562): every protocol module does
+``from hbbft_tpu.obs import trace`` on import, and that must not drag
+``http.server`` (via server.py) into simulations that never scrape.
+"""
+
+from typing import Any
+
+_EXPORTS = {
+    "TraceBuffer": "hbbft_tpu.obs.trace",
+    "TraceEvent": "hbbft_tpu.obs.trace",
+    "chrome_trace": "hbbft_tpu.obs.export",
+    "phase_spans": "hbbft_tpu.obs.export",
+    "phase_summaries": "hbbft_tpu.obs.export",
+    "write_chrome_trace": "hbbft_tpu.obs.export",
+    "ObsServer": "hbbft_tpu.obs.server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from hbbft_tpu.crypto.pool import VerifySink
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.protocols.bool_set import BoolSet
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.sbv_broadcast import AuxMsg, BValMsg, SbvBroadcast
@@ -238,6 +239,7 @@ class BinaryAgreement(ConsensusProtocol):
         conf threshold is reached — stash the value in that case.
         """
         self._coin_value = s
+        _trace.emit("ba.coin", round=self._round, value=int(s))
         return self._maybe_advance()
 
     def _maybe_advance(self) -> Step:
@@ -257,6 +259,7 @@ class BinaryAgreement(ConsensusProtocol):
     # -- rounds and termination ---------------------------------------
     def _next_round(self) -> Step:
         self._round += 1
+        _trace.emit("ba.round", round=self._round)
         self._sbv = SbvBroadcast(self._netinfo)
         self._conf_sent = False
         self._confs = {}
@@ -302,5 +305,6 @@ class BinaryAgreement(ConsensusProtocol):
             return step
         self._decision = b
         self._terminated = True
+        _trace.emit("ba.decide", round=self._round, value=int(b))
         step.broadcast(AbaMessage(self._round, TermMsg(b)))
         return step.with_output(b)

@@ -33,6 +33,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.ops.gf256 import rs_codec
 from hbbft_tpu.ops.merkle import MerkleTree, Proof
 from hbbft_tpu.protocols.network_info import NetworkInfo
@@ -207,6 +208,7 @@ class Broadcast(ConsensusProtocol):
         ):
             return step.fault(sender, FAULT_INVALID_PROOF)
         self._echo_sent = True
+        _trace.emit("rbc.value", proposer=self._proposer)
         # Full Echo (with the shard) to everyone still needing shards —
         # Target.all_except so observers (not in the validator set) keep
         # receiving shards — and hash-only Echo to peers that declared
@@ -300,6 +302,7 @@ class Broadcast(ConsensusProtocol):
     def _send_ready(self, root: bytes) -> Step:
         step = Step.empty()
         self._ready_sent = True
+        _trace.emit("rbc.ready", proposer=self._proposer)
         step.broadcast(ReadyMsg(root))
         step.extend(self._handle_ready(self.our_id, root))
         return step

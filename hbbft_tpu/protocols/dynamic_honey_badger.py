@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from hbbft_tpu.crypto.pool import VerifySink
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.protocols.honey_badger import (
     Batch,
     EncryptionSchedule,
@@ -381,6 +382,12 @@ class DynamicHoneyBadger(ConsensusProtocol):
         # The scoped sink pins this HB's era: verification callbacks of a
         # finished era keep only their fault reports.
         era = self._era
+        # Tracer era ctx must advance HERE, not only at handle_message
+        # entry: an era change runs inside a batch's processing, and the
+        # new HoneyBadger's _EpochState(0) emits epoch.open immediately —
+        # with a stale ctx the new era's first epoch would be keyed to
+        # the OLD era and corrupt both eras' phase spans.
+        _trace.set_ctx(era=era)
         return HoneyBadger(
             self._netinfo,
             self._sink.scoped(lambda s, e=era: self._on_hb_step_era(e, s)),
@@ -459,6 +466,9 @@ class DynamicHoneyBadger(ConsensusProtocol):
             if len(self._next_era_buffer) < _FUTURE_ERA_BUFFER_PER_SENDER:
                 self._next_era_buffer.append((sender, message))
             return step
+        # Tracer context: epoch-level milestones below HB carry the era
+        # they belong to (era changes restart HB's epoch counter at 0).
+        _trace.set_ctx(era=self._era)
         return step.extend(self._lift(self._hb.handle_message(sender, message.inner, rng)))
 
     # -- internals -----------------------------------------------------

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from hbbft_tpu.crypto.keys import Ciphertext
 from hbbft_tpu.crypto.pool import VerifySink
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.subset import Subset, SubsetOutput
 from hbbft_tpu.protocols.threshold_decrypt import ThresholdDecrypt
@@ -131,6 +132,12 @@ class _EpochState:
     """Reference: upstream ``src/honey_badger/epoch_state.rs``."""
 
     def __init__(self, hb: "HoneyBadger", epoch: int) -> None:
+        # Flight-recorder milestone (no-op without an installed tracer;
+        # leaf milestones below epoch level are BRACKETED by these
+        # open/commit events — obs/export.py).  Epoch-level events carry
+        # no proposer: drop any leaf ctx left by the previous message.
+        _trace.clear_ctx("proposer")
+        _trace.emit("epoch.open", epoch=epoch)
         self.hb = hb
         self.epoch = epoch
         self.encrypted = hb.encryption_schedule.encrypt_on(epoch)
@@ -177,6 +184,7 @@ class _EpochState:
         step = Step.empty()
         if not self.encrypted:
             return step.extend(self._accept_plaintext(proposer, payload))
+        _trace.emit("decrypt.start", proposer=proposer)
         ct = serde.try_loads(payload, suite=self.hb._suite())
         if not isinstance(ct, Ciphertext):
             self.faulty_proposers.add(proposer)
@@ -212,6 +220,8 @@ class _EpochState:
             self.faulty_proposers.add(proposer)
             step.fault(proposer, FAULT_BAD_CIPHERTEXT)
             step.extend(self._try_batch())
+        if outputs:
+            _trace.emit("decrypt.done", proposer=proposer)
         for plaintext in outputs:
             step.extend(self._accept_plaintext(proposer, plaintext))
         return step
@@ -266,6 +276,10 @@ class _EpochState:
         batch = Batch(
             self.epoch,
             tuple(sorted(self.decrypted.items(), key=lambda kv: str(kv[0]))),
+        )
+        _trace.clear_ctx("proposer")  # epoch events carry no proposer
+        _trace.emit(
+            "epoch.commit", epoch=self.epoch, contribs=len(batch.contributions)
         )
         step.with_output(batch)
         return step

@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 from hbbft_tpu.crypto.pool import VerifySink
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.protocols.binary_agreement import BinaryAgreement
 from hbbft_tpu.protocols.broadcast import Broadcast
 from hbbft_tpu.protocols.network_info import NetworkInfo
@@ -116,6 +117,9 @@ class Subset(ConsensusProtocol):
             known = False
         if not known:
             return step.fault(sender, FAULT_UNKNOWN_PROPOSER)
+        # Tracer context: leaf milestones (BA coin flips/rounds) emit
+        # without knowing which proposer's instance they serve.
+        _trace.set_ctx(proposer=message.proposer)
         prop = self._proposals[message.proposer]
         if message.kind == BC:
             return self._on_bc_step(
@@ -137,6 +141,7 @@ class Subset(ConsensusProtocol):
         for value in outputs:
             if prop.value is None:
                 prop.value = value
+                _trace.emit("rbc.deliver", proposer=proposer)
                 # Deliver => vote to include this proposer.
                 step.extend(self._input_ba(proposer, True))
         step.extend(self._progress(proposer))
@@ -155,6 +160,7 @@ class Subset(ConsensusProtocol):
 
     def _input_ba(self, proposer: Any, value: bool) -> Step:
         prop = self._proposals[proposer]
+        _trace.set_ctx(proposer=proposer)
         return self._on_ba_step(proposer, prop.ba.handle_input(value, None))
 
     def _after_decision(self) -> Step:

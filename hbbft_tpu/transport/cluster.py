@@ -54,6 +54,9 @@ from hbbft_tpu.crypto.backend import BatchedBackend, CryptoBackend
 from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
 from hbbft_tpu.crypto.pool import VerifyPool
 from hbbft_tpu.crypto.suite import ScalarSuite, Suite
+from hbbft_tpu.obs import trace as _trace
+from hbbft_tpu.obs.export import chrome_trace, phase_summaries, summarize
+from hbbft_tpu.obs.trace import TraceBuffer, TraceEvent
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.queueing_honey_badger import Input, QueueingHoneyBadger
@@ -61,7 +64,7 @@ from hbbft_tpu.protocols.sender_queue import SenderQueue, SqMessage
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
 from hbbft_tpu.transport.transport import TcpTransport
 from hbbft_tpu.utils import serde
-from hbbft_tpu.utils.metrics import Metrics
+from hbbft_tpu.utils.metrics import EpochTracker, Metrics
 
 
 def deal_keys(
@@ -93,6 +96,28 @@ def build_netinfo(
     )
 
 
+def track_commits(
+    epochs: EpochTracker, batches: Sequence[DhbBatch], last_t: float
+) -> float:
+    """Record commit latency for ``batches`` (both node impls route
+    committed batches through here): each epoch's latency is the
+    commit-to-commit interval at this node — ``started_at`` is the
+    previous commit (or node start), so the first measurement includes
+    cluster ramp-up honestly.  Returns the new last-commit time."""
+    for b in batches:
+        now = time.time()
+        key = (b.era, b.epoch)
+        epochs.start(key, last_t)
+        txns = sum(
+            len(c) if isinstance(c, (list, tuple)) else 1
+            for _, c in b.contributions
+            if c
+        )
+        epochs.finish(key, now, contributions=len(b.contributions), txns=txns)
+        last_t = now
+    return last_t
+
+
 class ClusterNode:
     """One node: protocol thread + transport, joined by an inbox."""
 
@@ -108,6 +133,7 @@ class ClusterNode:
         protocol_factory: Callable[[NetworkInfo, Any, random.Random], ConsensusProtocol],
         metrics: Optional[Metrics] = None,
         inbox_cap: int = 50_000,
+        trace: Optional[TraceBuffer] = None,
     ) -> None:
         self.id = node_id
         self.netinfo = netinfo
@@ -116,6 +142,13 @@ class ClusterNode:
         self.backend = backend
         self.suite = suite
         self.metrics = metrics if metrics is not None else transport.metrics
+        # Flight recorder (round 12): the protocol thread installs this
+        # buffer as its thread-local tracer, so the protocol modules'
+        # milestone emits land here; epoch commit latency feeds the
+        # epoch.latency summary via the tracker.
+        self.trace = trace
+        self.epochs = EpochTracker()
+        self._last_commit_t = time.time()
         self.rng = random.Random((seed << 16) ^ (node_id + 1))
         self.pool = VerifyPool()
         self.protocol = protocol_factory(netinfo, self.pool, self.rng)
@@ -130,6 +163,7 @@ class ClusterNode:
         )
         self._thread: Optional[threading.Thread] = None
         self._stop = False
+        self._ran_before = False
         self._lock = threading.Lock()  # snapshot vs append on outputs
         transport.on_message = self._on_frame_payload
 
@@ -169,6 +203,7 @@ class ClusterNode:
     def start(self) -> None:
         assert self._thread is None
         self._stop = False
+        self._last_commit_t = time.time()
         self._thread = threading.Thread(
             target=self._run, name=f"node-{self.id}", daemon=True
         )
@@ -181,8 +216,30 @@ class ClusterNode:
         self._thread.join(timeout=10)
         self._thread = None
 
+    def last_committed(self) -> Optional[Tuple[int, int]]:
+        """(era, epoch) of the newest committed batch, or None."""
+        with self._lock:
+            if not self._batches:
+                return None
+            b = self._batches[-1]
+            return (b.era, b.epoch)
+
+    def _track_commits(self, batches: List[DhbBatch]) -> None:
+        if batches:
+            self._last_commit_t = track_commits(
+                self.epochs, batches, self._last_commit_t
+            )
+
     # -- protocol thread -----------------------------------------------
     def _run(self) -> None:
+        _trace.install(self.trace)
+        if not self._ran_before:
+            # The first epoch's state was built in __init__ on the MAIN
+            # thread (no tracer installed): re-emit its open here so
+            # epoch 0 gets a complete span.  A fresh node is always at
+            # (era 0, epoch 0) before its protocol thread first runs.
+            self._ran_before = True
+            _trace.emit("epoch.open", era=0, epoch=0)
         while not self._stop:
             try:
                 kind, a, b = self.inbox.get(timeout=0.2)
@@ -210,11 +267,11 @@ class ClusterNode:
 
     def _process_step(self, step: Step) -> None:
         if step.output:
+            batches = [o for o in step.output if isinstance(o, DhbBatch)]
             with self._lock:
                 self.outputs.extend(step.output)
-                self._batches.extend(
-                    o for o in step.output if isinstance(o, DhbBatch)
-                )
+                self._batches.extend(batches)
+            self._track_commits(batches)
         if step.fault_log.faults:
             self.faults.extend(step.fault_log.faults)
             self.metrics.count("cluster.protocol_faults", len(step.fault_log.faults))
@@ -312,6 +369,20 @@ class LocalCluster:
         self.cluster_id = cluster_id
         self.injector = injector
         self.metrics = Metrics()
+        # Flight recorder (round 12): one bounded event ring per node
+        # plus a cluster-level ring (chaos schedule events).  The rings
+        # live HERE, not on the node objects, so a kill/restart drill
+        # keeps one continuous timeline per node id across rebirths.
+        self.trace = TraceBuffer("cluster")
+        self.traces: Dict[int, TraceBuffer] = {
+            i: TraceBuffer(f"node{i}") for i in range(n)
+        }
+        self._obs_server: Any = None
+        # Phase-summary TTL cache: deriving spans re-walks every ring
+        # snapshot, which is fine once per run but not once per scrape —
+        # a Prometheus poller must not re-pay it per request (stop()
+        # invalidates, so end-of-run reads are exact).
+        self._phase_cache: Optional[Tuple[float, Dict[str, Any]]] = None
         # node_impl (round 9): "python" (the oracle ClusterNode above),
         # "native" (engine-per-node NativeClusterNode — the whole
         # decode+handle loop in C), or a {node_id: impl} mapping for
@@ -378,6 +449,7 @@ class LocalCluster:
 
     def _make_node(self, i: int, t: TcpTransport):
         netinfo = build_netinfo(self.n, self.f, self.seed, self.suite, i)
+        t.tracer = self.traces[i]  # transport milestones share the ring
         if self._impl_for(i) == "native":
             from hbbft_tpu.transport.native_node import NativeClusterNode
 
@@ -390,6 +462,7 @@ class LocalCluster:
                 seed=self.seed,
                 batch_size=self._batch_size,
                 session_id=self._session_id,
+                trace=self.traces[i],
             )
         else:
             node = ClusterNode(
@@ -401,6 +474,7 @@ class LocalCluster:
                 suite=self.suite,
                 seed=self.seed,
                 protocol_factory=self._factory,
+                trace=self.traces[i],
             )
         spec = self.byzantine.get(i)
         if spec is not None:
@@ -429,9 +503,13 @@ class LocalCluster:
         self._started = True
 
     def stop(self) -> None:
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
         for node in self.nodes.values():
             node.stop()
             node.transport.stop()
+        self._phase_cache = None  # end-of-run reads must be exact
         self._started = False
 
     def __enter__(self) -> "LocalCluster":
@@ -493,6 +571,11 @@ class LocalCluster:
     def batches_from(self, node_id: int, start: int) -> List[DhbBatch]:
         return self.nodes[node_id].batches_from(start)
 
+    def last_committed(self, node_id: int) -> Optional[Tuple[int, int]]:
+        """(era, epoch) of the node's newest committed batch (None
+        before its first commit) — the /healthz liveness payload."""
+        return self.nodes[node_id].last_committed()
+
     def wait(
         self,
         pred: Callable[["LocalCluster"], bool],
@@ -550,7 +633,11 @@ class LocalCluster:
         )
 
     # -- observability -------------------------------------------------
-    def merged_metrics(self) -> Metrics:
+    def merged_metrics(self, fresh: bool = False) -> Metrics:
+        """Merge every node's metrics plus the derived observability
+        summaries.  ``fresh=True`` bypasses the phase-summary TTL cache
+        — end-of-run snapshots (benchmark JSON lines) must be exact
+        even when a live scraper primed the cache seconds earlier."""
         m = Metrics()
         for node in self.nodes.values():
             node.transport.export_metrics()
@@ -560,7 +647,73 @@ class LocalCluster:
             # injected-fault totals land in the same Prometheus dump as
             # the transport/cluster counters (faults.* gauges)
             self.injector.export_metrics(m)
+        # epoch.latency (round 12): commit-to-commit latency across every
+        # node's tracker, as one Prometheus summary (replaces the ad-hoc
+        # per-benchmark epoch math); per-node committed counts ride as
+        # gauges next to the transport's per-peer series.
+        lats: List[float] = []
+        for i, node in self.nodes.items():
+            tracker = getattr(node, "epochs", None)
+            if tracker is None:
+                continue
+            node_lats = tracker.latencies()
+            lats.extend(node_lats)
+            m.gauge(f"epoch.{i}.committed", len(node_lats))
+        sm = summarize(lats)
+        if sm is not None:
+            quant, count, total = sm
+            m.summary("epoch.latency", quant, count, total)
+        # phase.* (round 12): the per-epoch phase-latency breakdown
+        # derived from the flight-recorder rings (rbc / ba / coin /
+        # decrypt / epoch spans — obs/export.py), TTL-cached so a
+        # polling scraper pays the ring walk at most once per 2 s.
+        now = time.monotonic()
+        # local read: stop() clears the attribute from another thread
+        # between a scrape handler's check and its dereference
+        cache = self._phase_cache
+        if not fresh and cache is not None and now < cache[0]:
+            phases = cache[1]
+        else:
+            phases = phase_summaries(self.trace_events())
+            self._phase_cache = (now + 2.0, phases)
+        for phase, (quant, count, total) in sorted(phases.items()):
+            m.summary(f"phase.{phase}", quant, count, total)
         return m
+
+    def trace_events(self) -> Dict[str, List[TraceEvent]]:
+        """Snapshot of every trace ring, keyed by track name (the
+        per-node rings plus the cluster ring when non-empty)."""
+        out: Dict[str, List[TraceEvent]] = {
+            buf.track: buf.snapshot() for buf in self.traces.values()
+        }
+        cluster_events = self.trace.snapshot()
+        if cluster_events:
+            out[self.trace.track] = cluster_events
+        return out
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The merged Chrome trace-event JSON object (one track per
+        node; loads in Perfetto / ``chrome://tracing``)."""
+        pids = {self.traces[i].track: i for i in self.traces}
+        return chrome_trace(self.trace_events(), pids=pids)
+
+    def write_trace(self, path: str) -> str:
+        """Write :meth:`chrome_trace` to ``path``; returns the path."""
+        import json
+
+        with open(path, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+        return path
+
+    def serve_obs(self, host: str = "127.0.0.1", port: int = 0) -> Any:
+        """Start (or return) the live scrape server (``/metrics``,
+        ``/trace.json``, ``/healthz``) — usable mid-run; stopped by
+        :meth:`stop`."""
+        if self._obs_server is None:
+            from hbbft_tpu.obs.server import ObsServer
+
+            self._obs_server = ObsServer(self, host=host, port=port).start()
+        return self._obs_server
 
     def transport_stats(self) -> Dict[int, Dict[Any, Dict[str, int]]]:
         return {i: node.transport.stats() for i, node in self.nodes.items()}

@@ -36,14 +36,17 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, List, Optional, Tuple
 
 from hbbft_tpu.crypto.suite import Suite
 from hbbft_tpu.native_engine import NativeNodeEngine
+from hbbft_tpu.obs.trace import TraceBuffer
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
 from hbbft_tpu.protocols.network_info import NetworkInfo
+from hbbft_tpu.transport.cluster import track_commits
 from hbbft_tpu.transport.transport import TcpTransport
-from hbbft_tpu.utils.metrics import Metrics
+from hbbft_tpu.utils.metrics import EpochTracker, Metrics
 
 #: Max inbox items coalesced into one processing sweep.  Bounds how
 #: long egress draining can starve behind a flood of inbound bursts;
@@ -72,12 +75,22 @@ class NativeClusterNode:
         session_id: bytes = b"tcp-cluster",
         metrics: Optional[Metrics] = None,
         inbox_cap: int = 50_000,
+        trace: Optional[TraceBuffer] = None,
     ) -> None:
         self.id = node_id
         self.netinfo = netinfo
         self.all_ids = list(all_ids)
         self.transport = transport
         self.metrics = metrics if metrics is not None else transport.metrics
+        # Flight recorder (round 12): the engine's bounded event log is
+        # drained into this ring once per sweep (one ctypes call); the
+        # engine side emits with no per-event allocation.
+        self.trace = trace
+        self.epochs = EpochTracker()
+        self._last_commit_t = time.time()
+        self._seen_batches = 0
+        self._prof_last: dict = {}  # (kind, type) -> last published value
+        self._next_prof_sync = 0.0
         self.engine = NativeNodeEngine(
             node_id,
             netinfo,
@@ -85,6 +98,7 @@ class NativeClusterNode:
             batch_size=batch_size,
             session_id=session_id,
             suite=suite,
+            trace_capacity=8192 if trace is not None else 0,
         )
         # Bounded, like ClusterNode.inbox: a peer streaming faster than
         # the engine drains hits receive-side backpressure (the burst is
@@ -124,9 +138,18 @@ class NativeClusterNode:
     def batches_from(self, start: int) -> List[DhbBatch]:
         return self.engine.outputs[start:]
 
+    def last_committed(self) -> Optional[Tuple[int, int]]:
+        """(era, epoch) of the newest committed batch, or None."""
+        outs = self.engine.outputs
+        if not outs:
+            return None
+        b = outs[-1]  # GIL-atomic tail read of an append-only list
+        return (b.era, b.epoch)
+
     def start(self) -> None:
         assert self._thread is None
         self._stop = False
+        self._last_commit_t = time.time()
         self._thread = threading.Thread(
             target=self._run, name=f"native-node-{self.id}", daemon=True
         )
@@ -136,8 +159,17 @@ class NativeClusterNode:
         if self._thread is None:
             return
         self._stop = True  # flag, not a queue item: survives a full inbox
-        self._thread.join(timeout=10)
+        t = self._thread
+        t.join(timeout=10)
         self._thread = None
+        # Final export only once the protocol thread has ACTUALLY
+        # exited: then this (main-thread) engine access preserves the
+        # one-caller rule temporally and end-of-run metrics carry the
+        # full counters.  A thread that outlived the timed join (wedged
+        # handler) still owns the engine — touching the non-thread-safe
+        # vectors concurrently would race it, so skip the sync.
+        if not t.is_alive():
+            self._sync_engine_counters(force=True)
 
     # -- protocol thread -----------------------------------------------
     def _run(self) -> None:
@@ -149,7 +181,7 @@ class NativeClusterNode:
             try:
                 item = self.inbox.get(timeout=0.2)
             except queue.Empty:
-                self._sync_engine_counters()
+                self._guarded_sync()
                 continue
             burst = [item]
             while len(burst) < _MAX_COALESCE:
@@ -200,11 +232,28 @@ class NativeClusterNode:
                     self.transport.send_many(egress)
             except Exception:
                 self.metrics.count("cluster.handler_errors")
-            self._sync_engine_counters()
+            self._guarded_sync()
 
-    def _sync_engine_counters(self) -> None:
-        """Export engine-side fault entries into Metrics (protocol
-        thread only: the engine's fault vector is not thread-safe)."""
+    def _guarded_sync(self) -> None:
+        """Protocol-thread sync with the standard never-die guard: the
+        exporter grew real work in round 12 (ring drain + struct
+        decode + tracker math + prof reads) and an exporter bug must
+        not take the protocol thread down mid-run — count it loudly
+        like every other handler error (tests assert the counter stays
+        zero)."""
+        try:
+            self._sync_engine_counters()
+        except Exception:
+            self.metrics.count("cluster.handler_errors")
+
+    def _sync_engine_counters(self, force: bool = False) -> None:
+        """Export engine-side observables into Metrics / the trace ring
+        (protocol thread only while it runs: none of the engine's
+        vectors are thread-safe).  Per call: fault deltas, committed-
+        batch commit latencies, and the engine trace drain; the typed
+        profiling counters (``engine.cyc.* / engine.msgs.*``) publish on
+        a ~1 s throttle (32 ctypes reads — too heavy per sweep, cheap
+        per second) and unconditionally with ``force`` (node stop)."""
         eng = self.engine
         if not eng.handle:
             return
@@ -214,3 +263,32 @@ class NativeClusterNode:
                 "cluster.protocol_faults", total - self._synced_faults
             )
             self._synced_faults = total
+        outs = eng.outputs
+        committed = len(outs) > self._seen_batches
+        if committed:
+            new = outs[self._seen_batches:]
+            self._seen_batches = len(outs)
+            self._last_commit_t = track_commits(
+                self.epochs, new, self._last_commit_t
+            )
+        if self.trace is not None:
+            events = eng.drain_trace()
+            if events:
+                self.trace.extend(events)
+        now = time.monotonic()
+        # Also publish on commit sweeps (at most once per epoch): a
+        # mid-run scrape right after an epoch lands must see its cycles
+        # without waiting out the idle throttle.
+        if force or committed or now >= self._next_prof_sync:
+            self._next_prof_sync = now + 1.0
+            # Deltas as COUNTERS (not gauges): counters sum across the
+            # per-node Metrics in merged_metrics(), so the cluster dump
+            # carries cluster-wide native cycle splits.
+            for tname, st in eng.prof_stats().items():
+                for field, kind in (("cycles", "cyc"), ("count", "msgs")):
+                    cur = st[field]
+                    key = (kind, tname)
+                    delta = cur - self._prof_last.get(key, 0)
+                    if delta > 0:
+                        self.metrics.count(f"engine.{kind}.{tname}", delta)
+                        self._prof_last[key] = cur

@@ -307,6 +307,10 @@ class TcpTransport:
         self.ban_base_s = ban_base_s
         self.ban_cap_s = ban_cap_s
         self._bans: Dict[Any, _BanState] = {}
+        # Flight recorder (round 12): an optional TraceBuffer the owner
+        # (LocalCluster) installs; connect/disconnect/ban milestones land
+        # on the same per-node timeline as the protocol events.
+        self.tracer: Any = None
         self._rng = random.Random(f"transport|{seed}|{node_id}")
         self._host = host
         self._sel = selectors.DefaultSelector()
@@ -689,6 +693,9 @@ class TcpTransport:
             if st.connects > 1:
                 st.reconnects += 1
                 self.metrics.count("transport.reconnects")
+            self._trace(
+                "transport.connect", peer=dest, reconnect=st.connects > 1
+            )
             # handshake first, then whatever queued up.  The HELLO gets
             # a pending_write SENTINEL (orig None) so write_prog stays
             # frame-aligned: without it the handshake bytes inflate
@@ -808,6 +815,11 @@ class TcpTransport:
             return
         ob.want_w = want
 
+    def _trace(self, name: str, **args: Any) -> None:
+        t = self.tracer
+        if t is not None:
+            t.emit(name, **args)
+
     def _drop_outbound(self, dest: Any, ob: _Outbound, redial: bool) -> None:
         if ob.sock is not None:
             try:
@@ -816,6 +828,8 @@ class TcpTransport:
                 pass
             ob.sock.close()
             ob.sock = None
+        if ob.state == "connected":
+            self._trace("transport.disconnect", peer=dest)
         ob.state = "idle"
         ob.decoder = None
         ob.await_ack = False
@@ -968,6 +982,9 @@ class TcpTransport:
             b.until = time.monotonic() + dur
             st.bans = b.bans
             self.metrics.count("transport.peer_bans")
+            self._trace(
+                "transport.ban", peer=pid, offense=b.bans, duration_s=dur
+            )
 
     def _send_ack(self, conn: _Inbound) -> None:
         count = self._rx_counts[conn.peer_id]

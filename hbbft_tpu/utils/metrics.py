@@ -235,11 +235,16 @@ class Metrics:
         """Prometheus exposition format (text/plain version 0.0.4).
 
         Dotted/arrow metric names ride in a ``name`` label (labels admit
-        any UTF-8) under three fixed metric families, so per-peer series
+        any UTF-8) under a few fixed metric families, so per-peer series
         (``transport.0->1.queue_frames``) stay distinguishable without
         name mangling.  Label values are escaped per the exposition
         format (backslash, quote, newline) — metric names can embed
-        peer-announced node ids, which are untrusted.
+        peer-announced node ids, which are untrusted.  Every family
+        carries its ``# HELP``/``# TYPE`` header pair, and each timer
+        additionally exports its max single observation as the
+        ``_max`` gauge family (tracked by :class:`TimerStats` — a
+        summary has no max series of its own).  The line grammar is
+        golden-pinned by tests/test_obs.py.
         """
 
         def esc(name: str) -> str:
@@ -252,10 +257,18 @@ class Metrics:
         counters, gauges, timers, summaries = self._snapshot()
         lines: List[str] = []
         if counters:
+            lines.append(
+                f"# HELP {prefix}_count Monotonic event counters"
+                " (dotted source name in the 'name' label)."
+            )
             lines.append(f"# TYPE {prefix}_count counter")
             for k in sorted(counters):
                 lines.append(f'{prefix}_count{{name="{esc(k)}"}} {counters[k]}')
         if gauges:
+            lines.append(
+                f"# HELP {prefix}_gauge Point-in-time observables"
+                " (last write wins)."
+            )
             lines.append(f"# TYPE {prefix}_gauge gauge")
             for k in sorted(gauges):
                 # .12g, not :g — byte totals exported as gauges exceed
@@ -264,6 +277,10 @@ class Metrics:
                     f'{prefix}_gauge{{name="{esc(k)}"}} {gauges[k]:.12g}'
                 )
         if timers:
+            lines.append(
+                f"# HELP {prefix}_timer_seconds Wall-clock timer"
+                " observations (count/sum per name)."
+            )
             lines.append(f"# TYPE {prefix}_timer_seconds summary")
             for k in sorted(timers):
                 st = timers[k]
@@ -274,7 +291,21 @@ class Metrics:
                     f'{prefix}_timer_seconds_sum{{name="{esc(k)}"}} '
                     f"{st.total_s:.12g}"
                 )
+            lines.append(
+                f"# HELP {prefix}_timer_seconds_max Largest single"
+                " observation per timer."
+            )
+            lines.append(f"# TYPE {prefix}_timer_seconds_max gauge")
+            for k in sorted(timers):
+                lines.append(
+                    f'{prefix}_timer_seconds_max{{name="{esc(k)}"}} '
+                    f"{timers[k].max_s:.12g}"
+                )
         if summaries:
+            lines.append(
+                f"# HELP {prefix}_summary Quantile snapshots published"
+                " by streaming estimators (latency percentiles)."
+            )
             lines.append(f"# TYPE {prefix}_summary summary")
             for k in sorted(summaries):
                 sm = summaries[k]
@@ -310,22 +341,44 @@ class EpochStats:
 
 
 class EpochTracker:
-    """Collects EpochStats keyed by (era, epoch)."""
+    """Collects EpochStats keyed by (era, epoch).
+
+    Lock-protected (round 12): a cluster node's protocol thread records
+    commits while a scrape/driver thread reads latencies for the
+    ``epoch.latency`` summary export."""
 
     def __init__(self) -> None:
         self._stats: Dict[Tuple[int, int], EpochStats] = {}
+        self._lock = threading.Lock()
 
     def start(self, epoch: Tuple[int, int], now: float) -> None:
-        self._stats.setdefault(epoch, EpochStats(epoch=epoch, started_at=now))
+        with self._lock:
+            self._stats.setdefault(
+                epoch, EpochStats(epoch=epoch, started_at=now)
+            )
 
     def finish(
         self, epoch: Tuple[int, int], now: float, contributions: int, txns: int
     ) -> None:
-        st = self._stats.setdefault(epoch, EpochStats(epoch=epoch, started_at=now))
-        if st.finished_at is None:
-            st.finished_at = now
-            st.contributions = contributions
-            st.txns = txns
+        with self._lock:
+            st = self._stats.setdefault(
+                epoch, EpochStats(epoch=epoch, started_at=now)
+            )
+            if st.finished_at is None:
+                st.finished_at = now
+                st.contributions = contributions
+                st.txns = txns
 
     def all(self) -> List[EpochStats]:
-        return [self._stats[k] for k in sorted(self._stats)]
+        with self._lock:
+            return [self._stats[k] for k in sorted(self._stats)]
+
+    def latencies(self) -> List[float]:
+        """Commit latencies of every finished epoch (export feed for
+        the ``epoch.latency`` summary)."""
+        with self._lock:
+            return [
+                st.finished_at - st.started_at
+                for st in self._stats.values()
+                if st.finished_at is not None
+            ]

@@ -1066,6 +1066,44 @@ enum ClStat {
   CL_ANNOUNCES = 7,      // epoch_started broadcasts emitted
 };
 
+// -- flight recorder (ISSUE 9) ----------------------------------------------
+//
+// A bounded ring of milestone events (epoch open/commit, RBC value/
+// ready/deliver, BA round/coin/decide, decrypt start/done) stamped
+// with CLOCK_REALTIME nanoseconds so per-node rings from different
+// engines/processes merge on one wall clock (hbbft_tpu/obs/).  The
+// ring is preallocated at hbe_trace_enable — emitting is a branch, a
+// clock read and seven stores, no allocation — and overflow drops the
+// OLDEST record with a count (the drain cadence of the cluster
+// runtime makes that rare; a flood is bounded either way).  Names
+// mirror the Python tracer taxonomy (native_engine.TRACE_KIND_NAMES).
+enum TraceKind : int32_t {
+  TR_EPOCH_OPEN = 1,     // a=era, b=epoch
+  TR_EPOCH_COMMIT = 2,   // a=era, b=epoch, c=contribution count
+  TR_RBC_VALUE = 3,      // a=era, b=epoch, c=proposer (valid Value accepted)
+  TR_RBC_READY = 4,      // a=era, b=epoch, c=proposer (our Ready broadcast)
+  TR_RBC_DELIVER = 5,    // a=era, b=epoch, c=proposer (subset got the value)
+  TR_BA_ROUND = 6,       // a=era, b=epoch, c=proposer, d=new round
+  TR_BA_COIN = 7,        // a=era, b=epoch, c=proposer, d=(round<<1)|parity
+  TR_BA_DECIDE = 8,      // a=era, b=epoch, c=proposer, d=(round<<1)|value
+  TR_DECRYPT_START = 9,  // a=era, b=epoch, c=proposer
+  TR_DECRYPT_DONE = 10,  // a=era, b=epoch, c=proposer
+};
+
+struct TraceRec {
+  int64_t ts_ns;  // CLOCK_REALTIME at emit
+  int32_t node;   // observing engine node id
+  int32_t kind;   // TraceKind
+  int32_t a, b, c, d;
+};
+
+struct TraceState {
+  std::vector<TraceRec> ring;  // preallocated at enable; cap 0 = off
+  uint32_t cap = 0;
+  uint64_t head = 0, tail = 0;  // unwrapped write/read cursors
+  uint64_t dropped = 0;
+};
+
 struct ClusterState {
   int32_t local = -1;  // engine id of the local node; -1 = not cluster mode
   int32_t window = 3;  // SenderQueue max_future_epochs send gate
@@ -1192,7 +1230,27 @@ struct Engine {
   // -- cluster (one-engine-per-node) mode (ISSUE 5) ------------------------
   // Sequential-only, like the deferred cadences: hbe_run_mt falls back.
   ClusterState cluster;
+  // -- flight recorder (ISSUE 9) -------------------------------------------
+  // Sequential-only, like the counters above: emits are unguarded
+  // single-writer stores, so hbe_trace_enable is rejected for runs
+  // that will use engine_run_mt (the emit sites check !mt_active).
+  TraceState trace;
 };
+
+inline void trace_emit(Engine& e, int32_t node, int32_t kind, int32_t a,
+                       int32_t b, int32_t c, int32_t d) {
+  if (!e.trace.cap || e.mt_active) return;
+  TraceState& t = e.trace;
+  if (t.head - t.tail == t.cap) {
+    t.tail++;
+    t.dropped++;
+  }
+  int64_t ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                   std::chrono::system_clock::now().time_since_epoch())
+                   .count();
+  t.ring[t.head % t.cap] = TraceRec{ns, node, kind, a, b, c, d};
+  t.head++;
+}
 
 const size_t MASK_CACHE_MAX = 4096;
 
@@ -2107,6 +2165,8 @@ struct Ctx {
 
   void ba_on_coin(EpochState& st, int proposer, Ba& ba, uint8_t parity) {
     ba.coin_value = parity ? 1 : 0;
+    trace_emit(e, node.id, TR_BA_COIN, node.era, st.epoch, proposer,
+               (ba.round << 1) | (parity ? 1 : 0));
     ba_maybe_advance(st, proposer, ba);
   }
 
@@ -2131,6 +2191,8 @@ struct Ctx {
 
   void ba_next_round(EpochState& st, int proposer, Ba& ba) {
     ba.round += 1;
+    trace_emit(e, node.id, TR_BA_ROUND, node.era, st.epoch, proposer,
+               ba.round);
     ba.sbv = Sbv(n(), f());
     ba.conf_sent = false;
     ba.confs.clear();
@@ -2223,6 +2285,8 @@ struct Ctx {
     if (ba.terminated) return;
     ba.decision = b ? 1 : 0;
     ba.terminated = true;
+    trace_emit(e, node.id, TR_BA_DECIDE, node.era, st.epoch, proposer,
+               (ba.round << 1) | (b ? 1 : 0));
     EMsg m;
     m.era = node.era;
     m.epoch = st.epoch;
@@ -2342,6 +2406,7 @@ struct Ctx {
     Proposal& prop = st.proposals[proposer];
     if (!prop.value) {
       prop.value = value;
+      trace_emit(e, node.id, TR_RBC_DELIVER, node.era, st.epoch, proposer, 0);
       ba_input(st, proposer, prop.ba, true);
     }
     subset_progress(st, proposer);
@@ -2511,6 +2576,7 @@ struct Ctx {
       return;
     }
     bc.echo_sent = true;
+    trace_emit(e, node.id, TR_RBC_VALUE, node.era, st.epoch, proposer, 0);
     // Full Echo to everyone except CanDecode-declared peers; hash-only
     // Echo to those (broadcast.py _handle_value).
     NodeSet hash_only;
@@ -2640,6 +2706,7 @@ struct Ctx {
   void bc_send_ready(EpochState& st, int proposer, Bcast& bc,
                      const Root& root) {
     bc.ready_sent = true;
+    trace_emit(e, node.id, TR_RBC_READY, node.era, st.epoch, proposer, 0);
     bc_send_root(st, proposer, BC_READY, root, -1);
     bc_handle_ready(st, proposer, bc, node.id, root);
   }
@@ -3119,6 +3186,8 @@ struct Ctx {
   void hb_on_decrypt_boundary(int proposer, std::shared_ptr<Td> td,
                               std::vector<BytesP>& plain_out) {
     EpochState& st = node.hb.state;
+    if (!plain_out.empty())
+      trace_emit(e, node.id, TR_DECRYPT_DONE, node.era, st.epoch, proposer, 0);
     if (td->ciphertext_invalid && !st.faulty_proposers.has(proposer)) {
       st.faulty_proposers.add(proposer);
       ops.fault(proposer, F_HB_BAD_CT);
@@ -3167,6 +3236,8 @@ struct Ctx {
     for (auto& kv : st.plaintexts) ids.push_back(kv.first);
     ids = str_sorted(ids);
     for (int p : ids) bd.contributions.push_back({p, st.plaintexts[p]});
+    trace_emit(e, node.id, TR_EPOCH_COMMIT, node.era, st.epoch,
+               (int32_t)bd.contributions.size(), 0);
     node.pending_batches.push_back(std::move(bd));
   }
 
@@ -3200,6 +3271,7 @@ struct Ctx {
       hb_accept_plaintext(st, proposer, payload);
       return;
     }
+    trace_emit(e, node.id, TR_DECRYPT_START, node.era, st.epoch, proposer, 0);
     if (e.ext) {
       // serde decode verdict comes from Python (identical to
       // honey_badger._start_decrypt's try_loads gate).
@@ -3237,6 +3309,7 @@ struct Ctx {
   // the exhaustive per-field resets (EpochState::reset_for_epoch +
   // Proposal::reset), pinned by the native equivalence suites.
   void hb_reset_state(EpochState& st, int epoch) {
+    trace_emit(e, node.id, TR_EPOCH_OPEN, node.era, epoch, 0, 0);
     st.reset_for_epoch();
     st.epoch = epoch;
     st.encrypted = node.hb.encrypt_on(epoch);
@@ -5873,6 +5946,46 @@ int64_t hbe_node_egress_drain(void* h, uint8_t* out, uint64_t cap) {
 uint64_t hbe_node_stat(void* h, int32_t idx) {
   if (idx < 0 || idx >= 8) return 0;
   return ((Engine*)h)->cluster.stats[idx];
+}
+
+// -- flight recorder (ISSUE 9) ----------------------------------------------
+
+// Enable the milestone event ring with `cap` records (0 disables and
+// frees it).  One preallocation here; emitting never allocates.
+void hbe_trace_enable(void* h, uint32_t cap) {
+  TraceState& t = ((Engine*)h)->trace;
+  t.ring.assign(cap, TraceRec{});
+  t.ring.shrink_to_fit();
+  t.cap = cap;
+  t.head = t.tail = 0;
+  t.dropped = 0;
+}
+
+// Drain every retained record (oldest first) into `out` as packed
+// 32-byte little-endian structs {i64 ts_ns; i32 node, kind, a, b, c, d}.
+// Returns the record count, or -1 if `cap_bytes` is too small for the
+// current backlog (drains nothing — call again with a bigger buffer).
+int64_t hbe_trace_drain(void* h, uint8_t* out, uint64_t cap_bytes) {
+  TraceState& t = ((Engine*)h)->trace;
+  uint64_t count = t.head - t.tail;
+  if (count * sizeof(TraceRec) > cap_bytes) return -1;
+  for (uint64_t i = 0; i < count; ++i) {
+    std::memcpy(out + i * sizeof(TraceRec), &t.ring[(t.tail + i) % t.cap],
+                sizeof(TraceRec));
+  }
+  t.tail = t.head;
+  return (int64_t)count;
+}
+
+// Records pending in the ring (sizes the drain buffer).
+uint64_t hbe_trace_pending(void* h) {
+  TraceState& t = ((Engine*)h)->trace;
+  return t.head - t.tail;
+}
+
+// Total records lost to ring overflow since enable.
+uint64_t hbe_trace_dropped(void* h) {
+  return ((Engine*)h)->trace.dropped;
 }
 
 // -- wire-codec test surface ------------------------------------------------

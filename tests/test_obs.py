@@ -1,0 +1,484 @@
+"""Flight recorder (round 12): trace rings, exports, live scrape.
+
+Four pinned surfaces:
+
+* the Prometheus exposition's LINE GRAMMAR (a strict golden parse —
+  scrapers are unforgiving, and metric names embed untrusted peer ids
+  that must be escaped, never interpolated raw);
+* the Chrome trace-event schema (every emitted event carries the
+  ``ts/pid/tid/ph/name`` quintet Perfetto requires) plus the phase-span
+  derivation rules (epoch bracketing of leaf milestones);
+* bounded memory: a flood into a TraceBuffer retains exactly
+  ``capacity`` events and counts the drops;
+* the live endpoints: ``urllib`` against ``/metrics``, ``/healthz``
+  and ``/trace.json`` DURING a driven N=4 cluster (both arms where a
+  compiler exists).
+
+Budget: the cluster phases keep the standard 45 s caps (typical < 5 s
+on this box); no jax/XLA involvement (``make obs-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from hbbft_tpu.obs import trace as trace_mod
+from hbbft_tpu.obs.export import (
+    chrome_trace,
+    phase_spans,
+    phase_summaries,
+    summarize,
+)
+from hbbft_tpu.obs.trace import TraceBuffer, TraceEvent
+from hbbft_tpu.transport import LocalCluster
+from hbbft_tpu.utils.metrics import Metrics
+
+EPOCH_TIMEOUT_S = 45  # wall cap per driven phase; typical is < 5 s
+
+
+def _native_available() -> bool:
+    from hbbft_tpu import native_engine
+
+    return native_engine.get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# TraceBuffer + thread-local tracer
+# ---------------------------------------------------------------------------
+
+
+def test_trace_buffer_bounded_under_flood():
+    buf = TraceBuffer("t", capacity=512)
+    for i in range(50_000):
+        buf.emit("flood", i=i)
+    assert len(buf) == 512
+    assert buf.dropped == 50_000 - 512
+    snap = buf.snapshot()
+    assert len(snap) == 512
+    # Oldest-first order and drop-oldest semantics: the retained window
+    # is exactly the newest `capacity` events.
+    assert [e.args["i"] for e in snap] == list(range(50_000 - 512, 50_000))
+    # The ring never grows: the backing list is still `capacity` slots.
+    assert len(buf._ring) == 512
+
+
+def test_trace_buffer_extend_applies_same_bound():
+    buf = TraceBuffer("t", capacity=16)
+    buf.extend([TraceEvent(float(i), "x", {}) for i in range(100)])
+    assert len(buf) == 16 and buf.dropped == 84
+    assert buf.snapshot()[0].ts == 84.0
+
+
+def test_thread_local_tracer_noop_without_install():
+    trace_mod.install(None)
+    trace_mod.emit("nobody.listening", x=1)  # must not raise
+    trace_mod.set_ctx(era=7)  # must not raise or leak anywhere
+    buf = TraceBuffer("t", capacity=8)
+    trace_mod.install(buf)
+    try:
+        trace_mod.set_ctx(era=3, proposer=1)
+        trace_mod.emit("ba.coin", round=0, value=1)
+        trace_mod.emit("ba.coin", proposer=2)  # explicit overrides ctx
+    finally:
+        trace_mod.install(None)
+    a, b = buf.snapshot()
+    assert a.args == {"era": 3, "proposer": 1, "round": 0, "value": 1}
+    assert b.args["proposer"] == 2 and b.args["era"] == 3
+
+
+def test_tracer_is_thread_local():
+    buf = TraceBuffer("t", capacity=8)
+    trace_mod.install(buf)
+    try:
+        seen = []
+
+        def other():
+            trace_mod.emit("other.thread")  # no tracer HERE: dropped
+            seen.append(True)
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert seen and len(buf) == 0
+    finally:
+        trace_mod.install(None)
+
+
+# ---------------------------------------------------------------------------
+# Phase spans + chrome trace schema
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_track():
+    # One epoch: open -> rbc -> ba(coin) -> decrypt -> commit, with the
+    # leaf events NOT carrying epoch args (the Python-arm bracketing).
+    mk = TraceEvent
+    return [
+        mk(10.0, "epoch.open", {"era": 0, "epoch": 5}),
+        mk(10.1, "rbc.value", {"proposer": 1}),
+        mk(10.2, "rbc.deliver", {"proposer": 1}),
+        mk(10.3, "ba.coin", {"proposer": 1, "round": 0, "value": 1}),
+        mk(10.4, "ba.decide", {"proposer": 1, "round": 0, "value": 1}),
+        mk(10.5, "decrypt.start", {"proposer": 1}),
+        mk(10.7, "decrypt.done", {"proposer": 1}),
+        mk(11.0, "epoch.commit", {"era": 0, "epoch": 5, "contribs": 4}),
+    ]
+
+
+def test_phase_spans_bracketing_and_durations():
+    spans = phase_spans({"node0": _synthetic_track()})
+    by_phase = {s["phase"]: s for s in spans}
+    assert set(by_phase) == {"epoch", "rbc", "ba", "coin", "decrypt"}
+    for s in spans:
+        assert (s["era"], s["epoch"]) == (0, 5)
+    assert by_phase["epoch"]["t0"] == 10.0 and by_phase["epoch"]["t1"] == 11.0
+    assert by_phase["rbc"]["t1"] == 10.2  # open -> last rbc.deliver
+    assert by_phase["ba"]["t0"] == 10.3 and by_phase["ba"]["t1"] == 10.4
+    assert abs(
+        by_phase["decrypt"]["t1"] - by_phase["decrypt"]["t0"] - 0.2
+    ) < 1e-9
+
+    sums = phase_summaries({"node0": _synthetic_track()})
+    quant, count, total = sums["epoch"]
+    assert count == 1 and abs(total - 1.0) < 1e-9 and quant[0.5] == total
+
+
+def test_unbracketed_leaf_events_are_skipped():
+    # Leaf milestones before any epoch.open (ring overflow ate it) must
+    # not crash or fabricate a span.
+    evs = [TraceEvent(1.0, "ba.coin", {"proposer": 0})]
+    assert phase_spans({"n": evs}) == []
+
+
+def test_decrypt_span_requires_done():
+    # decrypt.start alone (node killed mid-epoch / done lost to ring
+    # overflow) must NOT fabricate a 0 s decrypt span — zeros would
+    # drag the phase.decrypt quantiles down (review finding).
+    evs = [
+        TraceEvent(1.0, "epoch.open", {"era": 0, "epoch": 0}),
+        TraceEvent(1.1, "decrypt.start", {"proposer": 2}),
+    ]
+    assert [s["phase"] for s in phase_spans({"n": evs})] == []
+
+
+def test_epoch_events_drop_stale_proposer_ctx():
+    # A Subset message sets ctx proposer; the next epoch-level emit must
+    # not inherit it (schema parity with the native arm's records).
+    buf = TraceBuffer("t", capacity=8)
+    trace_mod.install(buf)
+    try:
+        trace_mod.set_ctx(era=0, proposer=3)
+        trace_mod.clear_ctx("proposer")
+        trace_mod.emit("epoch.commit", epoch=1, contribs=4)
+    finally:
+        trace_mod.install(None)
+    (ev,) = buf.snapshot()
+    assert "proposer" not in ev.args and ev.args["era"] == 0
+
+
+def test_chrome_trace_schema_and_roundtrip():
+    tracks = {"node0": _synthetic_track(), "cluster": [
+        TraceEvent(10.6, "chaos.kill", {"node": 3}),
+    ]}
+    doc = chrome_trace(tracks, pids={"node0": 0})
+    body = json.loads(json.dumps(doc))  # JSON-serializable end to end
+    events = body["traceEvents"]
+    assert events, "no events emitted"
+    for ev in events:
+        for key in ("ts", "pid", "tid", "ph", "name"):
+            assert key in ev, f"event missing {key}: {ev}"
+    # one process per track, metadata names present
+    names = {
+        (e["pid"], e["args"]["name"])
+        for e in events
+        if e["name"] == "process_name"
+    }
+    assert names == {(0, "node0"), (1, "cluster")}
+    # phase spans became complete events with durations
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"].split()[0] for e in xs} >= {"epoch", "rbc", "ba"}
+    assert all(e["dur"] >= 1 for e in xs)
+    # instants reference the relative clock (µs from the earliest event)
+    i0 = min(e["ts"] for e in events if e["ph"] == "i")
+    assert i0 == 0.0
+
+
+def test_summarize_quantiles():
+    quant, count, total = summarize([3.0, 1.0, 2.0, 4.0])
+    assert count == 4 and total == 10.0
+    assert quant[0.5] == 3.0 and quant[0.99] == 4.0
+    assert summarize([]) is None
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: strict golden parse
+# ---------------------------------------------------------------------------
+
+_COMMENT_RE = re.compile(
+    r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* \S.*$"
+)
+_SAMPLE_RE = re.compile(
+    r"^(?P<metric>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?'
+    r" (-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]?Inf|NaN)$"
+)
+
+
+def test_prometheus_exposition_golden_parse():
+    m = Metrics()
+    m.count("transport.frames", 12)
+    m.count('weird"name\\with\nall three', 1)  # escaping surface
+    m.gauge("transport.0->1.queue_bytes", 123456789012.0)
+    with m.timer("flush"):
+        pass
+    m.summary("epoch.latency", {0.5: 0.01, 0.99: 0.2}, count=10, total=0.5)
+    text = m.prometheus_text()
+    assert text.endswith("\n")
+
+    seen_types: dict = {}
+    for line in text.splitlines():
+        assert line, "blank line in exposition"
+        if line.startswith("#"):
+            assert _COMMENT_RE.match(line), f"bad comment line: {line!r}"
+            kind, family = line.split()[1:3]
+            if kind == "TYPE":
+                # HELP must precede TYPE for every family
+                assert seen_types.get(family) == "help", line
+                seen_types[family] = "type"
+            else:
+                assert family not in seen_types, f"duplicate HELP: {line!r}"
+                seen_types[family] = "help"
+        else:
+            mt = _SAMPLE_RE.match(line)
+            assert mt, f"bad sample line: {line!r}"
+            metric = mt.group("metric")
+            # every sample belongs to a declared family (summary
+            # children share the family prefix)
+            assert any(
+                metric == fam or metric.startswith(fam + "_")
+                for fam in seen_types
+            ), f"sample before its TYPE: {line!r}"
+
+    # round-12 satellite: HELP lines + the per-timer max gauge family
+    assert "# HELP hbbft_count " in text
+    assert "# TYPE hbbft_timer_seconds_max gauge" in text
+    assert 'hbbft_timer_seconds_max{name="flush"} ' in text
+    # escaping: the raw control bytes must never appear unescaped
+    assert 'weird\\"name\\\\with\\nall three' in text
+
+
+def test_era_change_rekeys_epoch_open_ctx():
+    """An era change rebuilds HoneyBadger INSIDE batch processing; the
+    new era's epoch-0 open must carry the NEW era (a stale thread-local
+    ctx would corrupt both eras' phase spans — review finding)."""
+    from hbbft_tpu.crypto.pool import VerifyPool
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.protocols.dynamic_honey_badger import DynamicHoneyBadger
+    from hbbft_tpu.transport.cluster import build_netinfo
+
+    ni = build_netinfo(4, 1, 0, ScalarSuite(), 0)
+    buf = TraceBuffer("t", capacity=64)
+    trace_mod.install(buf)
+    try:
+        dhb = DynamicHoneyBadger(ni, VerifyPool(), session_id=b"obs-era")
+        ev = buf.snapshot()[-1]
+        assert ev.name == "epoch.open"
+        assert ev.args["era"] == 0 and ev.args["epoch"] == 0
+        # era advance rebuilds the inner HB via _make_hb (the same call
+        # _restart_era makes); its epoch.open must carry era=3
+        dhb._era = 3
+        dhb._hb = dhb._make_hb()
+        ev = buf.snapshot()[-1]
+        assert ev.name == "epoch.open"
+        assert ev.args["era"] == 3 and ev.args["epoch"] == 0
+    finally:
+        trace_mod.install(None)
+
+
+# ---------------------------------------------------------------------------
+# Chaos events land on the cluster track
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_runner_emits_cluster_track_events():
+    from hbbft_tpu.chaos.scheduler import ChaosEvent, ChaosRunner
+
+    class StubCluster:
+        def __init__(self):
+            self.trace = TraceBuffer("cluster", capacity=64)
+            self.calls = []
+
+        def kill(self, n):
+            self.calls.append(("kill", n))
+
+        def restart(self, n):
+            self.calls.append(("restart", n))
+
+    c = StubCluster()
+    runner = ChaosRunner(
+        c, [ChaosEvent(0.0, "kill", 3), ChaosEvent(0.0, "restart", 3)]
+    )
+    runner.start()
+    runner.drain()
+    assert c.calls == [("kill", 3), ("restart", 3)]
+    assert [e.name for e in c.trace.snapshot()] == [
+        "chaos.kill",
+        "chaos.restart",
+    ]
+    assert c.trace.snapshot()[0].args["node"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Live cluster: rings fill, endpoints answer mid-run, both arms trace
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str) -> bytes:
+    return urllib.request.urlopen(url, timeout=10).read()
+
+
+def _drive(cluster, target: int) -> None:
+    cluster.drive_to(
+        range(cluster.n), target, timeout_s=EPOCH_TIMEOUT_S, tag="obs"
+    )
+
+
+def test_live_scrape_python_cluster():
+    c = LocalCluster(4, seed=0)
+    with c:
+        port = c.serve_obs().port
+        base = f"http://127.0.0.1:{port}"
+        # scrape MID-RUN: before any epoch commits...
+        health0 = json.loads(_get(base + "/healthz"))
+        assert health0["ok"] and len(health0["nodes"]) == 4
+        _drive(c, 2)
+        # ...and while the cluster is still live after progress
+        text = _get(base + "/metrics").decode()
+        for line in text.splitlines():
+            assert line.startswith("#") and _COMMENT_RE.match(line) or (
+                _SAMPLE_RE.match(line)
+            ), f"unparseable scrape line: {line!r}"
+        assert 'hbbft_summary{name="epoch.latency"' in text
+        assert 'name="phase.ba"' in text  # derived phase breakdown
+        health = json.loads(_get(base + "/healthz"))
+        assert health["ok"]
+        assert all(n["alive"] for n in health["nodes"].values())
+        assert health["nodes"]["0"]["last_committed"] is not None
+        doc = json.loads(_get(base + "/trace.json"))
+        pids = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert {"node0", "node1", "node2", "node3"} <= pids
+        for ev in doc["traceEvents"]:
+            for key in ("ts", "pid", "tid", "ph", "name"):
+                assert key in ev
+        # 404 surface
+        with pytest.raises(urllib.error.HTTPError):
+            _get(base + "/nope")
+    # stop() tears the server down
+    with pytest.raises(Exception):
+        _get(base + "/healthz")
+
+
+def test_trace_timeline_python_cluster_content():
+    c = LocalCluster(4, seed=0)
+    with c:
+        _drive(c, 2)
+    evs = c.trace_events()
+    assert set(evs) >= {"node0", "node1", "node2", "node3"}
+    for track in ("node0", "node1", "node2", "node3"):
+        names = {e.name for e in evs[track]}
+        # the full taxonomy appears on every node's timeline
+        assert {
+            "epoch.open",
+            "epoch.commit",
+            "rbc.value",
+            "rbc.deliver",
+            "ba.coin",
+            "ba.decide",
+            "decrypt.start",
+            "transport.connect",
+        } <= names, f"{track} missing milestones: {names}"
+        opens = [e for e in evs[track] if e.name == "epoch.open"]
+        assert any(e.args.get("epoch") == 0 for e in opens)
+    # derived spans cover committed epochs on every node
+    spans = phase_spans(evs)
+    epochs_spanned = {
+        (s["track"], s["epoch"]) for s in spans if s["phase"] == "epoch"
+    }
+    for track in ("node0", "node1", "node2", "node3"):
+        assert (track, 0) in epochs_spanned
+    # merged metrics carry the phase breakdown + epoch latency summary
+    m = c.merged_metrics()
+    assert m.summaries["epoch.latency"].count >= 8  # 4 nodes x >= 2 epochs
+    assert "phase.rbc" in m.summaries and "phase.ba" in m.summaries
+
+
+@pytest.mark.skipif(
+    not _native_available(), reason="native engine unavailable"
+)
+def test_native_and_mixed_cluster_trace():
+    c = LocalCluster(
+        4, seed=0, node_impl={0: "python", 1: "native", 2: "python", 3: "native"}
+    )
+    with c:
+        _drive(c, 2)
+    evs = c.trace_events()
+    for track in ("node1", "node3"):  # the native arms
+        names = {e.name for e in evs[track]}
+        assert {
+            "epoch.open",
+            "epoch.commit",
+            "rbc.deliver",
+            "ba.coin",
+            "decrypt.done",
+        } <= names, f"native {track} missing milestones: {names}"
+        commits = [e for e in evs[track] if e.name == "epoch.commit"]
+        # engine events carry explicit era/epoch (no bracketing needed)
+        assert all(
+            "era" in e.args and "epoch" in e.args for e in commits
+        )
+    m = c.merged_metrics()
+    # native cycle splits are exported as counters (sum across nodes)
+    assert m.counters.get("engine.cyc.COIN", 0) > 0
+    assert m.counters.get("engine.msgs.BVAL", 0) > 0
+    # both arms appear in one chrome trace with their own pids
+    doc = c.chrome_trace()
+    pids = {
+        e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"]
+        if e["name"] == "process_name"
+    }
+    assert {"node0", "node1", "node2", "node3"} <= set(pids)
+    assert pids["node1"] == 1  # pid pinned to the node id
+
+
+@pytest.mark.skipif(
+    not _native_available(), reason="native engine unavailable"
+)
+def test_engine_trace_ring_bounded():
+    # The C ring must drop-oldest with an honest count, like the Python
+    # ring (flood it by driving many epochs through a tiny capacity).
+    from hbbft_tpu.native_engine import NativeQhbNet
+    from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+    net = NativeQhbNet(4, seed=0)
+    net.enable_trace(64)
+    for i in range(4):
+        net.send_input(i, Input.user(f"t{i}"))
+    net.run(20_000)
+    evs = net.drain_trace()
+    assert len(evs) <= 64
+    assert net.trace_dropped > 0
+    assert int(net.lib.hbe_trace_pending(net.handle)) == 0
+    # timestamps are wall-clock seconds, monotone within the ring
+    ts = [e.ts for e in evs]
+    assert ts == sorted(ts)
