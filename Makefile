@@ -57,5 +57,15 @@ obs-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py \
 		tests/test_metrics.py -q -m 'not slow'
 
+# Crypto-plane tier (ISSUE 12): the shared batched share-verification
+# service — service-arm vs inline-arm output identity on both node
+# impls, corrupt-share attribution parity, service-death fallback
+# drill, cadence/threads validation pins.  Runs on the Batched CPU
+# backend: no jax/XLA involvement — safe during crypto-cache cold
+# states; native halves skip cleanly without g++.
+cryptoplane-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cryptoplane.py \
+		-q -m 'not slow'
+
 .PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
-	chaos-smoke obs-smoke
+	chaos-smoke obs-smoke cryptoplane-smoke

@@ -133,6 +133,29 @@ def main() -> None:
                 f"production bucket {n_shares} shares "
                 f"(CHUNK={backend.CHUNK}) warmed in {time.time() - t0:.0f}s"
             )
+
+    # Crypto-plane service bucket (round 13): a cluster's shared
+    # CryptoPlaneService merges several nodes' sig/dec/ct checks into
+    # one device flush — the mixed-kind legs land in the SAME nl=8
+    # bucket warmed above, but route here through the service worker
+    # (config9's service-tpu arm) so the end-to-end path is exercised
+    # once while the cache is being built.
+    from hbbft_tpu.crypto.backend import BatchedBackend
+    from hbbft_tpu.cryptoplane import CryptoPlaneService
+
+    svc = CryptoPlaneService(backend, window_s=0.05)
+    # Distinct CPU fallback (the worker owns the TpuBackend — a timed-
+    # out client must never re-enter it concurrently) and a compile-
+    # scale timeout: this flush COLD is a multi-minute XLA build.
+    client = svc.client(BatchedBackend(suite), timeout_s=3600.0)
+    t0 = time.time()
+    ok = client.verify_batch(batches[8])
+    assert all(ok)
+    assert svc.metrics.counters.get("crypto.flushes", 0) == 1, (
+        svc.metrics.counters
+    )
+    svc.stop()
+    log(f"cryptoplane service flush warmed in {time.time() - t0:.0f}s")
     log("done")
 
 

@@ -1,0 +1,9 @@
+"""Cluster crypto plane (ISSUE 12): the shared batched
+share-verification service behind :class:`~hbbft_tpu.crypto.backend.
+CryptoBackend`, serving both cluster node arms.  See
+docs/CRYPTO_PLANE.md and :mod:`hbbft_tpu.cryptoplane.service`.
+"""
+
+from hbbft_tpu.cryptoplane.service import CryptoPlaneService, ServiceClient
+
+__all__ = ["CryptoPlaneService", "ServiceClient"]

@@ -768,6 +768,229 @@ class _EngineNetBase:
             out.append(TraceEvent(ts_ns / 1e9, name, args))
         return out
 
+    # -- external-crypto mode ------------------------------------------
+    #
+    # The opaque-bytes crypto plane (round 3), shared by BOTH engine
+    # runtimes: the simulated net (NativeQhbNet external_crypto=True)
+    # and the cluster-node engine (NativeNodeEngine with an attached
+    # backend — the crypto-service arm, round 13).  The callbacks run
+    # inside hbe_run / hbe_flush; exceptions must not cross the ctypes
+    # boundary: they are trapped, recorded, and re-raised by the
+    # caller's _raise_cb_error — with verdicts left False / results
+    # left empty, which the protocol tolerates structurally.
+
+    def _init_ext_crypto(
+        self, suite: Suite, backend: CryptoBackend, flush_every: int
+    ) -> None:
+        """Arm the engine's external (opaque-bytes) crypto mode: share
+        signing, verification, combining and ciphertext parsing route
+        through the Python callbacks below, and verify requests
+        accumulate in the engine's per-node pools until a flush hands
+        the whole batch to ``backend.verify_batch`` (``flush_every``
+        mirrors VirtualNet's knob; 0 = flush only on queue-dry)."""
+        self.ext = True
+        self._suite = suite
+        self.backend = backend
+        self._node_era_info: Dict[Tuple[int, int], NetworkInfo] = {}
+        self._era_netinfo: Dict[int, NetworkInfo] = {}
+        self._ct_cache: Dict[bytes, Any] = {}
+        self._h2g2_cache: Dict[bytes, Any] = {}
+        self._elem_cache: Dict[Tuple[bool, bytes], Any] = {}
+        self._verdict_memo: Dict[tuple, bool] = {}
+        self._dec_g1, self._dec_g2 = _share_decoders(suite)
+        self.flush_stats: Dict[str, int] = {
+            "flushes": 0,          # verify-batch callback invocations
+            "requests": 0,         # raw requests (incl. memo hits)
+            "backend_requests": 0, # requests actually sent to the backend
+            "max_batch": 0,        # largest single backend batch
+        }
+        # keep callback objects alive for the engine's lifetime
+        self._verify_cb = _VERIFY_CB(self._on_verify)
+        self._sign_cb = _SIGN_CB(self._on_sign)
+        self._combine_cb = _COMBINE_CB(self._on_combine)
+        self._ct_parse_cb = _CT_PARSE_CB(self._on_ct_parse)
+        self.lib.hbe_set_ext_crypto(
+            self.handle, flush_every, self._verify_cb, self._sign_cb,
+            self._combine_cb, self._ct_parse_cb,
+        )
+
+    def _read_vreq_bytes(self, len_fn: Any, get_fn: Any, i: int) -> bytes:
+        ln = int(len_fn(self.handle, i))
+        if not ln:
+            return b""
+        buf = (ctypes.c_uint8 * ln)()
+        get_fn(self.handle, i, buf)
+        return bytes(buf)
+
+    def _on_verify(self, node: int, count: int, verdicts: Any) -> None:
+        try:
+            lib = self.lib
+            pending = []  # (slot, memo key, VerifyRequest or None)
+            for i in range(count):
+                kind = lib.hbe_vreq_kind(self.handle, i)
+                era = lib.hbe_vreq_era(self.handle, i)
+                sender = lib.hbe_vreq_sender(self.handle, i)
+                share = self._read_vreq_bytes(
+                    lib.hbe_vreq_share_len, lib.hbe_vreq_share, i
+                )
+                if kind == 0:
+                    ctx = self._read_vreq_bytes(
+                        lib.hbe_vreq_doc_len, lib.hbe_vreq_doc, i
+                    )
+                else:
+                    ctx = self._read_vreq_bytes(
+                        lib.hbe_vreq_ct_len, lib.hbe_vreq_ct, i
+                    )
+                # Verdicts are pure functions of the request content, so
+                # identical requests observed by different nodes verify
+                # once (the backend still sees the whole UNIQUE batch).
+                key = (kind, era, sender, ctx, share)
+                memo = self._verdict_memo.get(key)
+                if memo is not None:
+                    verdicts[i] = 1 if memo else 0
+                    continue
+                pending.append(
+                    (i, key, self._build_request(kind, era, sender, ctx, share))
+                )
+            reqs = [r for (_, _, r) in pending if r is not None]
+            results = self.backend.verify_batch(reqs) if reqs else []
+            st = self.flush_stats
+            st["flushes"] += 1
+            st["requests"] += count
+            st["backend_requests"] += len(reqs)
+            if len(reqs) > st["max_batch"]:
+                st["max_batch"] = len(reqs)
+            it = iter(results)
+            for i, key, req in pending:
+                ok = bool(next(it)) if req is not None else False
+                _cache_put(self._verdict_memo, key, ok)
+                verdicts[i] = 1 if ok else 0
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+
+    def _build_request(
+        self, kind: int, era: int, sender: int, ctx: bytes, share: bytes
+    ) -> Optional[VerifyRequest]:
+        """Reconstruct a VerifyRequest from engine wire bytes.
+
+        Share points are decoded STRUCTURALLY only (no subgroup check):
+        the backend applies the wire membership policy itself
+        (request_well_formed / on-device torsion checks), exactly as for
+        in-process Python-net requests.  Undecodable bytes verify False.
+        """
+        ni = self._era_netinfo.get(era)
+        if ni is None:
+            return None
+        try:
+            if kind == 0:
+                return VerifyRequest.sig_share(
+                    ni.public_key_share(sender),
+                    ctx,
+                    SignatureShare(self._elem(share, g2=True), self._suite),
+                )
+            ct = self._ct_lookup(ctx)
+            if not isinstance(ct, Ciphertext):
+                return None
+            if kind == 1:
+                return VerifyRequest.dec_share(
+                    ni.public_key_share(sender),
+                    ct,
+                    DecryptionShare(self._elem(share, g2=False), self._suite),
+                )
+            return VerifyRequest.ciphertext(ct)
+        except Exception:
+            return None
+
+    def _elem(self, data: bytes, g2: bool) -> Any:
+        """Decode (and cache) a group element; cached points also keep
+        their memoized subgroup/affine state across verify+combine."""
+        key = (g2, data)
+        el = self._elem_cache.get(key)
+        if el is None:
+            el = (self._dec_g2 if g2 else self._dec_g1)(data)
+            _cache_put(self._elem_cache, key, el)
+        return el
+
+    def _ct_lookup(self, payload: bytes) -> Any:
+        """Ciphertext for a serde payload — cache, or re-decode after an
+        eviction (the payload IS the full encoding, so entries are
+        always re-derivable)."""
+        obj = self._ct_cache.get(payload)
+        if obj is None:
+            obj = serde.try_loads(payload, suite=self._suite)
+            _cache_put(
+                self._ct_cache, payload,
+                obj if isinstance(obj, Ciphertext) else _DECODE_FAILED,
+            )
+        return obj
+
+    def _on_sign(
+        self, node: int, era: int, kind: int, ctx_ptr: Any, ctx_len: int, ret: Any
+    ) -> None:
+        try:
+            ctx = ctypes.string_at(ctx_ptr, ctx_len) if ctx_len else b""
+            ni = self._node_era_info[(node, era)]
+            if kind == 0:
+                h = self._h2g2_cache.get(ctx)
+                if h is None:
+                    h = self._suite.hash_to_g2(ctx)
+                    _cache_put(self._h2g2_cache, ctx, h)
+                share = ni.secret_key_share.sign_hash_point(h)
+            else:
+                share = ni.secret_key_share.decryption_share(self._ct_lookup(ctx))
+            data = share.to_bytes()
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            self.lib.hbe_ret_bytes(ret, buf, len(data))
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+
+    def _on_combine(
+        self, node: int, era: int, kind: int, ctx_ptr: Any, ctx_len: int,
+        count: int, ret: Any,
+    ) -> None:
+        try:
+            ctx = ctypes.string_at(ctx_ptr, ctx_len) if ctx_len else b""
+            lib = self.lib
+            ni = self._era_netinfo[era]
+            pks = ni.public_key_set
+            shares: Dict[int, Any] = {}
+            for i in range(count):
+                idx = lib.hbe_comb_index(self.handle, i)
+                data = self._read_vreq_bytes(
+                    lib.hbe_comb_share_len, lib.hbe_comb_share, i
+                )
+                if kind == 0:
+                    shares[idx] = SignatureShare(
+                        self._elem(data, g2=True), self._suite
+                    )
+                else:
+                    shares[idx] = DecryptionShare(
+                        self._elem(data, g2=False), self._suite
+                    )
+            if kind == 0:
+                out = pks.combine_signatures(shares).to_bytes()
+            else:
+                out = pks.combine_decryption_shares(shares, self._ct_lookup(ctx))
+            buf = (ctypes.c_uint8 * len(out)).from_buffer_copy(out)
+            self.lib.hbe_ret_bytes(ret, buf, len(out))
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+
+    def _on_ct_parse(self, node: int, ptr: Any, length: int) -> int:
+        """serde decode gate for subset-accepted payloads — the exact
+        ``serde.try_loads`` + isinstance verdict of
+        honey_badger._start_decrypt, memoized per distinct payload."""
+        try:
+            payload = ctypes.string_at(ptr, length) if length else b""
+            return 1 if isinstance(self._ct_lookup(payload), Ciphertext) else 0
+        except BaseException as exc:  # pragma: no cover - defensive
+            if self._cb_error is None:
+                self._cb_error = exc
+            return 0
+
     def _raise_cb_error(self) -> None:
         if self._cb_error is not None:
             exc, self._cb_error = self._cb_error, None
@@ -970,27 +1193,10 @@ class NativeQhbNet(_EngineNetBase):
                 self._pre_crank_cb = _PRE_CRANK_CB(self._on_pre_crank)
                 lib.hbe_set_pre_crank(self.handle, self._pre_crank_cb)
         if self.ext:
-            self.backend = backend if backend is not None else BatchedBackend(suite)
-            self._node_era_info: Dict[Tuple[int, int], NetworkInfo] = {}
-            self._era_netinfo: Dict[int, NetworkInfo] = {}
-            self._ct_cache: Dict[bytes, Any] = {}
-            self._h2g2_cache: Dict[bytes, Any] = {}
-            self._elem_cache: Dict[Tuple[bool, bytes], Any] = {}
-            self._verdict_memo: Dict[tuple, bool] = {}
-            self._dec_g1, self._dec_g2 = _share_decoders(suite)
-            self.flush_stats: Dict[str, int] = {
-                "flushes": 0,          # verify-batch callback invocations
-                "requests": 0,         # raw requests (incl. memo hits)
-                "backend_requests": 0, # requests actually sent to the backend
-                "max_batch": 0,        # largest single backend batch
-            }
-            self._verify_cb = _VERIFY_CB(self._on_verify)
-            self._sign_cb = _SIGN_CB(self._on_sign)
-            self._combine_cb = _COMBINE_CB(self._on_combine)
-            self._ct_parse_cb = _CT_PARSE_CB(self._on_ct_parse)
-            lib.hbe_set_ext_crypto(
-                self.handle, flush_every, self._verify_cb, self._sign_cb,
-                self._combine_cb, self._ct_parse_cb,
+            self._init_ext_crypto(
+                suite,
+                backend if backend is not None else BatchedBackend(suite),
+                flush_every,
             )
 
         self.nodes: Dict[int, _NativeNode] = {}
@@ -1031,177 +1237,10 @@ class NativeQhbNet(_EngineNetBase):
                 else:
                     lib.hbe_set_silent(self.handle, i, 1)
 
-    # -- external-crypto callbacks -------------------------------------
-    #
-    # These run inside hbe_run / hbe_flush.  Exceptions must not cross
-    # the ctypes boundary: they are trapped, recorded, and re-raised by
-    # run() — with verdicts left False / results left empty, which the
-    # protocol tolerates structurally.
-
-    def _read_vreq_bytes(self, len_fn: Any, get_fn: Any, i: int) -> bytes:
-        ln = int(len_fn(self.handle, i))
-        if not ln:
-            return b""
-        buf = (ctypes.c_uint8 * ln)()
-        get_fn(self.handle, i, buf)
-        return bytes(buf)
-
-    def _on_verify(self, node: int, count: int, verdicts: Any) -> None:
-        try:
-            lib = self.lib
-            pending = []  # (slot, memo key, VerifyRequest or None)
-            for i in range(count):
-                kind = lib.hbe_vreq_kind(self.handle, i)
-                era = lib.hbe_vreq_era(self.handle, i)
-                sender = lib.hbe_vreq_sender(self.handle, i)
-                share = self._read_vreq_bytes(
-                    lib.hbe_vreq_share_len, lib.hbe_vreq_share, i
-                )
-                if kind == 0:
-                    ctx = self._read_vreq_bytes(
-                        lib.hbe_vreq_doc_len, lib.hbe_vreq_doc, i
-                    )
-                else:
-                    ctx = self._read_vreq_bytes(
-                        lib.hbe_vreq_ct_len, lib.hbe_vreq_ct, i
-                    )
-                # Verdicts are pure functions of the request content, so
-                # identical requests observed by different nodes verify
-                # once (the backend still sees the whole UNIQUE batch).
-                key = (kind, era, sender, ctx, share)
-                memo = self._verdict_memo.get(key)
-                if memo is not None:
-                    verdicts[i] = 1 if memo else 0
-                    continue
-                pending.append(
-                    (i, key, self._build_request(kind, era, sender, ctx, share))
-                )
-            reqs = [r for (_, _, r) in pending if r is not None]
-            results = self.backend.verify_batch(reqs) if reqs else []
-            st = self.flush_stats
-            st["flushes"] += 1
-            st["requests"] += count
-            st["backend_requests"] += len(reqs)
-            if len(reqs) > st["max_batch"]:
-                st["max_batch"] = len(reqs)
-            it = iter(results)
-            for i, key, req in pending:
-                ok = bool(next(it)) if req is not None else False
-                _cache_put(self._verdict_memo, key, ok)
-                verdicts[i] = 1 if ok else 0
-        except BaseException as exc:  # pragma: no cover - defensive
-            if self._cb_error is None:
-                self._cb_error = exc
-
-    def _build_request(
-        self, kind: int, era: int, sender: int, ctx: bytes, share: bytes
-    ) -> Optional[VerifyRequest]:
-        """Reconstruct a VerifyRequest from engine wire bytes.
-
-        Share points are decoded STRUCTURALLY only (no subgroup check):
-        the backend applies the wire membership policy itself
-        (request_well_formed / on-device torsion checks), exactly as for
-        in-process Python-net requests.  Undecodable bytes verify False.
-        """
-        ni = self._era_netinfo.get(era)
-        if ni is None:
-            return None
-        try:
-            if kind == 0:
-                return VerifyRequest.sig_share(
-                    ni.public_key_share(sender),
-                    ctx,
-                    SignatureShare(self._elem(share, g2=True), self._suite),
-                )
-            ct = self._ct_lookup(ctx)
-            if not isinstance(ct, Ciphertext):
-                return None
-            if kind == 1:
-                return VerifyRequest.dec_share(
-                    ni.public_key_share(sender),
-                    ct,
-                    DecryptionShare(self._elem(share, g2=False), self._suite),
-                )
-            return VerifyRequest.ciphertext(ct)
-        except Exception:
-            return None
-
-    def _elem(self, data: bytes, g2: bool) -> Any:
-        """Decode (and cache) a group element; cached points also keep
-        their memoized subgroup/affine state across verify+combine."""
-        key = (g2, data)
-        el = self._elem_cache.get(key)
-        if el is None:
-            el = (self._dec_g2 if g2 else self._dec_g1)(data)
-            _cache_put(self._elem_cache, key, el)
-        return el
-
-    def _ct_lookup(self, payload: bytes) -> Any:
-        """Ciphertext for a serde payload — cache, or re-decode after an
-        eviction (the payload IS the full encoding, so entries are
-        always re-derivable)."""
-        obj = self._ct_cache.get(payload)
-        if obj is None:
-            obj = serde.try_loads(payload, suite=self._suite)
-            _cache_put(
-                self._ct_cache, payload,
-                obj if isinstance(obj, Ciphertext) else _DECODE_FAILED,
-            )
-        return obj
-
-    def _on_sign(
-        self, node: int, era: int, kind: int, ctx_ptr: Any, ctx_len: int, ret: Any
-    ) -> None:
-        try:
-            ctx = ctypes.string_at(ctx_ptr, ctx_len) if ctx_len else b""
-            ni = self._node_era_info[(node, era)]
-            if kind == 0:
-                h = self._h2g2_cache.get(ctx)
-                if h is None:
-                    h = self._suite.hash_to_g2(ctx)
-                    _cache_put(self._h2g2_cache, ctx, h)
-                share = ni.secret_key_share.sign_hash_point(h)
-            else:
-                share = ni.secret_key_share.decryption_share(self._ct_lookup(ctx))
-            data = share.to_bytes()
-            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-            self.lib.hbe_ret_bytes(ret, buf, len(data))
-        except BaseException as exc:  # pragma: no cover - defensive
-            if self._cb_error is None:
-                self._cb_error = exc
-
-    def _on_combine(
-        self, node: int, era: int, kind: int, ctx_ptr: Any, ctx_len: int,
-        count: int, ret: Any,
-    ) -> None:
-        try:
-            ctx = ctypes.string_at(ctx_ptr, ctx_len) if ctx_len else b""
-            lib = self.lib
-            ni = self._era_netinfo[era]
-            pks = ni.public_key_set
-            shares: Dict[int, Any] = {}
-            for i in range(count):
-                idx = lib.hbe_comb_index(self.handle, i)
-                data = self._read_vreq_bytes(
-                    lib.hbe_comb_share_len, lib.hbe_comb_share, i
-                )
-                if kind == 0:
-                    shares[idx] = SignatureShare(
-                        self._elem(data, g2=True), self._suite
-                    )
-                else:
-                    shares[idx] = DecryptionShare(
-                        self._elem(data, g2=False), self._suite
-                    )
-            if kind == 0:
-                out = pks.combine_signatures(shares).to_bytes()
-            else:
-                out = pks.combine_decryption_shares(shares, self._ct_lookup(ctx))
-            buf = (ctypes.c_uint8 * len(out)).from_buffer_copy(out)
-            self.lib.hbe_ret_bytes(ret, buf, len(out))
-        except BaseException as exc:  # pragma: no cover - defensive
-            if self._cb_error is None:
-                self._cb_error = exc
+    # The external-crypto callbacks (_on_verify / _on_sign / _on_combine
+    # / _on_ct_parse and their helpers) live on _EngineNetBase: the
+    # cluster-node engine's crypto-service arm (round 13) shares them
+    # verbatim — only the ingress/egress runtime differs.
 
     # Engine MsgType values (native/engine.cpp enum MsgType).
     _MT_VALUE, _MT_ECHO, _MT_READY, _MT_ECHO_HASH, _MT_CAN_DECODE = range(5)
@@ -1309,18 +1348,6 @@ class NativeQhbNet(_EngineNetBase):
             at[new], at[p] = old, displaced
             pos[old], pos[displaced] = new, p
 
-    def _on_ct_parse(self, node: int, ptr: Any, length: int) -> int:
-        """serde decode gate for subset-accepted payloads — the exact
-        ``serde.try_loads`` + isinstance verdict of
-        honey_badger._start_decrypt, memoized per distinct payload."""
-        try:
-            payload = ctypes.string_at(ptr, length) if length else b""
-            return 1 if isinstance(self._ct_lookup(payload), Ciphertext) else 0
-        except BaseException as exc:  # pragma: no cover - defensive
-            if self._cb_error is None:
-                self._cb_error = exc
-            return 0
-
     # -- driving --------------------------------------------------------
     def send_input(self, nid: int, input: Any) -> None:
         nd = self.nodes[nid]
@@ -1383,9 +1410,30 @@ class NativeNodeEngine(_EngineNetBase):
     reused Python stack as everywhere else: ``QueueingHoneyBadger``
     over :class:`NativeDhb`, fed through the shared batch callbacks.
 
-    Scalar suite only (the cluster harness' protocol-plane suite);
-    ``flush_every`` is pinned to 1 — the byte-identical eager cadence —
-    so committed batches match the Python-node oracle exactly.
+    Scalar suite only (the cluster WIRE grammar pins the scalar-suite
+    32-byte share encoding — ``wenc_share_struct`` in native/
+    engine.cpp), in one of two crypto configurations:
+
+    * **internal scalar** (default, ``backend=None``) — the engine
+      computes the scalar-suite checks itself; ``flush_every`` is
+      pinned to 1 (the byte-identical eager cadence) so committed
+      batches match the Python-node oracle exactly.
+    * **external backend** (round 13, ``backend=...``) — the ext-crypto
+      mode under the cluster loop: shares travel as opaque bytes,
+      verification accumulates in the engine pool and flushes through
+      the attached :class:`~hbbft_tpu.crypto.backend.CryptoBackend`
+      (the cluster crypto-service arm routes this to the shared
+      :class:`~hbbft_tpu.cryptoplane.CryptoPlaneService`).  The
+      deferred cadence is accepted here (``flush_every=0`` = flush on
+      queue-dry, i.e. once per ingest sweep) — identical protocol
+      outputs by the standing deferred-verification invariant
+      (tests/test_cryptoplane.py pins ``batches_sha`` against the
+      scalar arm).
+
+    ``threads > 1`` composes only with the internal scalar mode at
+    ``flush_every=1`` — the same sequential-cadence rules as
+    :class:`NativeQhbNet` (and cluster mode runs sequentially in the
+    engine regardless; the option exists for rule consistency).
 
     Threading: NOT thread-safe.  One owner thread makes every call
     (ingest / handle_input / run / drain_egress); the transport thread
@@ -1414,6 +1462,9 @@ class NativeNodeEngine(_EngineNetBase):
         suite: Optional[Suite] = None,
         rlc: Optional[bool] = None,
         trace_capacity: int = 8192,
+        backend: Optional[CryptoBackend] = None,
+        flush_every: int = 1,
+        threads: int = 1,
     ) -> None:
         n = len(netinfo.all_ids)
         lib = get_lib(_words_for(n))
@@ -1422,8 +1473,35 @@ class NativeNodeEngine(_EngineNetBase):
         suite = suite if suite is not None else ScalarSuite()
         if not isinstance(suite, ScalarSuite):
             raise ValueError(
-                "NativeNodeEngine runs the scalar internal-crypto mode "
-                "only (the cluster protocol-plane suite)"
+                "NativeNodeEngine requires ScalarSuite (the cluster wire "
+                "grammar pins the scalar-suite share encoding; attach a "
+                "backend= for the external-crypto service arm)"
+            )
+        ext = backend is not None
+        self.threads = int(threads)
+        # The same cadence rules as NativeQhbNet: the external flush
+        # cadence and the deferred scalar cadence are sequential
+        # orderings, so they reject threads > 1; and WITHOUT an ext
+        # backend the node pins flush_every=1 — the byte-identical eager
+        # cadence the Python-node oracle equivalence rests on.
+        if self.threads > 1:
+            if ext:
+                raise ValueError(
+                    "threads > 1 requires the scalar-suite internal "
+                    "crypto mode (external-crypto flush cadence is "
+                    "sequential)"
+                )
+            if flush_every != 1:
+                raise ValueError(
+                    "threads > 1 requires flush_every=1 in scalar mode "
+                    "(the deferred scalar flush cadence is a sequential "
+                    "ordering, like external crypto's)"
+                )
+        if not ext and flush_every != 1:
+            raise ValueError(
+                "NativeNodeEngine pins flush_every=1 in scalar mode (the "
+                "Python-oracle byte-identity cadence); attach an external "
+                "CryptoBackend (backend=...) for the deferred cadence"
             )
         self.lib = lib
         self.n = n
@@ -1431,6 +1509,7 @@ class NativeNodeEngine(_EngineNetBase):
         self.ext = False
         self.node_id = node_id
         self._suite = suite
+        self.flush_every = flush_every
         self._cb_error: Optional[BaseException] = None
         self._decode_cache: Dict[bytes, Any] = {}
         self._slot_cache: Dict[tuple, Any] = {}
@@ -1439,6 +1518,11 @@ class NativeNodeEngine(_EngineNetBase):
         if rlc is not None:
             lib.hbe_set_rlc(self.handle, 1 if rlc else 0)
         lib.hbe_set_local(self.handle, node_id, self.SQ_WINDOW)
+        if ext:
+            # Must precede NativeDhb construction below: _make_hb
+            # branches on self.ext (era-info registration, keyless
+            # engine init) during DynamicHoneyBadger.__init__.
+            self._init_ext_crypto(suite, backend, flush_every)
         # Flight recorder (round 12): default-on for cluster nodes —
         # milestone-rate emits into a preallocated ring, drained by the
         # runtime once per sweep (trace_capacity=0 disables).
@@ -1498,8 +1582,15 @@ class NativeNodeEngine(_EngineNetBase):
         return handled
 
     def run(self, max_deliveries: int = 1 << 62) -> int:
-        """Drain the local delivery queue (returns when it is empty)."""
-        done = int(self.lib.hbe_run(self.handle, max_deliveries))
+        """Drain the local delivery queue (returns when it is empty;
+        in ext mode the queue-dry flush hands pending verifications to
+        the backend before returning)."""
+        if self.threads > 1:
+            done = int(
+                self.lib.hbe_run_mt(self.handle, max_deliveries, self.threads)
+            )
+        else:
+            done = int(self.lib.hbe_run(self.handle, max_deliveries))
         self._raise_cb_error()
         return done
 

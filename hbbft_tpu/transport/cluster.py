@@ -323,6 +323,9 @@ class LocalCluster:
         node_impl: Any = "python",
         byzantine: Optional[Dict[int, Any]] = None,
         transport_kwargs: Optional[Dict[str, Any]] = None,
+        crypto: str = "inline",
+        crypto_service: Any = None,
+        service_kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.n = n
         self.seed = seed
@@ -409,6 +412,49 @@ class LocalCluster:
         )
         self._factory = factory
         self._backend_factory = backend_factory
+        # crypto (round 13): "inline" verifies shares where they always
+        # were (scalar C in native nodes, a per-node backend in Python
+        # nodes); "service" routes BOTH arms' share checks through ONE
+        # shared CryptoPlaneService that batches requests across all
+        # nodes into single backend flushes (hbbft_tpu/cryptoplane/,
+        # docs/CRYPTO_PLANE.md).  The service's backend comes from
+        # backend_factory(suite) unless a pre-built service (e.g. over
+        # TpuBackend) is passed in; every node keeps a local
+        # BatchedBackend fallback, so a dead/slow service degrades to
+        # inline verification instead of stalling the cluster.
+        if crypto not in ("inline", "service"):
+            raise ValueError(
+                f"unknown crypto arm {crypto!r} (inline | service)"
+            )
+        if crypto_service is not None and crypto != "service":
+            raise ValueError("crypto_service requires crypto='service'")
+        self.crypto = crypto
+        self.crypto_service = crypto_service
+        self._owns_service = False
+        self._service_timeout_s = 30.0
+        if crypto == "service":
+            from hbbft_tpu.cryptoplane import CryptoPlaneService
+
+            kw = dict(service_kwargs or {})
+            self._service_timeout_s = float(kw.pop("timeout_s", 30.0))
+            if self.crypto_service is None:
+                self.crypto_service = CryptoPlaneService(
+                    backend_factory(self.suite),
+                    trace=TraceBuffer("cryptoplane"),
+                    **kw,
+                )
+                self._owns_service = True
+            elif kw:
+                # Construction kwargs cannot be applied to a pre-built
+                # service — silently ignoring them would misconfigure
+                # the run with no symptom beyond odd batch sizes.
+                raise ValueError(
+                    f"service_kwargs {sorted(kw)} cannot be applied to a "
+                    "pre-built crypto_service (only timeout_s, which "
+                    "configures the per-node clients)"
+                )
+        elif service_kwargs:
+            raise ValueError("service_kwargs requires crypto='service'")
         self._transport_kwargs: Dict[str, Any] = dict(
             max_queue_frames=max_queue_frames,
         )
@@ -447,9 +493,19 @@ class LocalCluster:
     def honest_ids(self) -> List[int]:
         return [i for i in range(self.n) if i not in self.byzantine]
 
+    def _service_client(self):
+        """A fresh per-node facade onto the shared verification service
+        (each carries its own local-CPU fallback backend; restart()
+        re-enters here, so a reborn node gets a live client even after
+        drills killed its predecessor mid-wait)."""
+        return self.crypto_service.client(
+            BatchedBackend(self.suite), timeout_s=self._service_timeout_s
+        )
+
     def _make_node(self, i: int, t: TcpTransport):
         netinfo = build_netinfo(self.n, self.f, self.seed, self.suite, i)
         t.tracer = self.traces[i]  # transport milestones share the ring
+        service = self.crypto == "service"
         if self._impl_for(i) == "native":
             from hbbft_tpu.transport.native_node import NativeClusterNode
 
@@ -463,6 +519,7 @@ class LocalCluster:
                 batch_size=self._batch_size,
                 session_id=self._session_id,
                 trace=self.traces[i],
+                crypto_backend=self._service_client() if service else None,
             )
         else:
             node = ClusterNode(
@@ -470,7 +527,11 @@ class LocalCluster:
                 netinfo=netinfo,
                 all_ids=list(range(self.n)),
                 transport=t,
-                backend=self._backend_factory(self.suite),
+                backend=(
+                    self._service_client()
+                    if service
+                    else self._backend_factory(self.suite)
+                ),
                 suite=self.suite,
                 seed=self.seed,
                 protocol_factory=self._factory,
@@ -509,6 +570,15 @@ class LocalCluster:
         for node in self.nodes.values():
             node.stop()
             node.transport.stop()
+        # Service AFTER the nodes: a protocol thread blocked in a
+        # verify wait fails over to its local fallback and exits
+        # cleanly; stopping the service first would only route the
+        # final flushes through the fallback needlessly.  Only the
+        # service THIS cluster built — stop() is terminal, and a
+        # caller-supplied service (e.g. config9's TpuBackend arm) may
+        # outlive the cluster; its owner stops it.
+        if self._owns_service and self.crypto_service is not None:
+            self.crypto_service.stop()
         self._phase_cache = None  # end-of-run reads must be exact
         self._started = False
 
@@ -647,6 +717,10 @@ class LocalCluster:
             # injected-fault totals land in the same Prometheus dump as
             # the transport/cluster counters (faults.* gauges)
             self.injector.export_metrics(m)
+        if self.crypto_service is not None:
+            # crypto.* service plane (round 13): flush count/latency,
+            # batch-size summary, queue depth, fallback totals
+            self.crypto_service.export_metrics(m)
         # epoch.latency (round 12): commit-to-commit latency across every
         # node's tracker, as one Prometheus summary (replaces the ad-hoc
         # per-benchmark epoch math); per-node committed counts ride as
@@ -689,6 +763,11 @@ class LocalCluster:
         cluster_events = self.trace.snapshot()
         if cluster_events:
             out[self.trace.track] = cluster_events
+        svc_trace = getattr(self.crypto_service, "trace", None)
+        if svc_trace is not None:
+            svc_events = svc_trace.snapshot()
+            if svc_events:
+                out[svc_trace.track] = svc_events
         return out
 
     def chrome_trace(self) -> Dict[str, Any]:

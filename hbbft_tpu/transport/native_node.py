@@ -76,6 +76,8 @@ class NativeClusterNode:
         metrics: Optional[Metrics] = None,
         inbox_cap: int = 50_000,
         trace: Optional[TraceBuffer] = None,
+        crypto_backend: Optional[Any] = None,
+        flush_every: Optional[int] = None,
     ) -> None:
         self.id = node_id
         self.netinfo = netinfo
@@ -91,6 +93,21 @@ class NativeClusterNode:
         self._seen_batches = 0
         self._prof_last: dict = {}  # (kind, type) -> last published value
         self._next_prof_sync = 0.0
+        # crypto_backend (round 13): run the engine's external-crypto
+        # mode with share verification routed through this backend —
+        # the cluster crypto-service arm (a ServiceClient of the shared
+        # CryptoPlaneService).  The deferred cadence (flush_every=0 =
+        # flush per ingest sweep at queue-dry) maximizes what each
+        # service batch can merge; output-identical to the inline
+        # scalar arm by the deferred-verification invariant.
+        engine_kwargs: dict = {}
+        if crypto_backend is not None:
+            engine_kwargs["backend"] = crypto_backend
+            engine_kwargs["flush_every"] = (
+                0 if flush_every is None else flush_every
+            )
+        elif flush_every is not None:
+            engine_kwargs["flush_every"] = flush_every
         self.engine = NativeNodeEngine(
             node_id,
             netinfo,
@@ -99,6 +116,7 @@ class NativeClusterNode:
             session_id=session_id,
             suite=suite,
             trace_capacity=8192 if trace is not None else 0,
+            **engine_kwargs,
         )
         # Bounded, like ClusterNode.inbox: a peer streaming faster than
         # the engine drains hits receive-side backpressure (the burst is

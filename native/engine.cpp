@@ -4096,6 +4096,20 @@ inline void wenc_share_struct(Bytes& o, const char* name, const U256& v) {
   wenc_group(o, v);
 }
 
+// External-crypto mode carries shares as opaque bytes (EMsg::share_b);
+// the cluster wire grammar stays the scalar suite's 32-byte element, so
+// an ext-scalar share (ScalarG.to_bytes == 32B BE) re-encodes exactly.
+// Oversized/odd lengths (a tamper hook rewrote the bytes) truncate via
+// u256_from_be, matching what any 32-byte wire slot could carry anyway.
+inline void wenc_share_emsg(Bytes& o, const char* name, const EMsg& m) {
+  if (m.share_b)
+    wenc_share_struct(
+        o, name,
+        u256_from_be((const uint8_t*)m.share_b->data(), m.share_b->size()));
+  else
+    wenc_share_struct(o, name, m.share);
+}
+
 Bytes wire_encode_algo(const EMsg& m) {
   Bytes o;
   wenc_struct(o, "sqmsg");
@@ -4112,7 +4126,7 @@ Bytes wire_encode_algo(const EMsg& m) {
     wenc_nonneg(o, (uint64_t)m.proposer);
     wenc_struct(o, "decmsg");
     wenc_tuple(o, 1);
-    wenc_share_struct(o, "decshare", m.share);
+    wenc_share_emsg(o, "decshare", m);
     return o;
   }
   wenc_str(o, "subset");
@@ -4174,7 +4188,7 @@ Bytes wire_encode_algo(const EMsg& m) {
           wenc_tuple(o, 1);
           wenc_struct(o, "signmsg");
           wenc_tuple(o, 1);
-          wenc_share_struct(o, "sigshare", m.share);
+          wenc_share_emsg(o, "sigshare", m);
           break;
       }
       break;
@@ -5895,10 +5909,21 @@ int64_t hbe_node_ingest_frames(void* h, const int32_t* senders,
     c.stats[CL_HANDLED]++;
     if (wm.kind == 1)
       cluster_on_epoch_started(e, s, wm.era, wm.epoch);
-    else if (wm.kind == 2)
+    else if (wm.kind == 2) {
+      if (e.ext &&
+          (wm.msg.type == BA_COIN || wm.msg.type == HB_DECRYPT)) {
+        // External-crypto mode consumes opaque share bytes (share_b);
+        // the wire codec decoded the scalar grammar's 32-byte element
+        // into the U256 slot — rematerialize the exact BE bytes so the
+        // handlers route them to the verify-batch callback instead of
+        // the (keyless, in ext mode) internal scalar checks.
+        uint8_t be[32];
+        u256_to_be32(wm.msg.share, be);
+        wm.msg.share_b = std::make_shared<const Bytes>((const char*)be, 32);
+      }
       e.queue.push_back(
           {s, c.local, std::make_shared<const EMsg>(std::move(wm.msg))});
-    else
+    } else
       c.stats[CL_IGNORED]++;
   }
   return handled;
