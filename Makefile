@@ -26,10 +26,13 @@ test-protocol:
 # and partition drills included (the ISSUE-4 acceptance surface), plus
 # the native-node tier (ISSUE-5: engine-per-node oracle equivalence,
 # drills re-run native, wire-codec fuzz parity — needs g++, skips
-# cleanly without one).
+# cleanly without one) and the process-per-node tier (ISSUE-13:
+# native_proc identity vs both thread arms, SIGKILL/restart drill,
+# per-worker scrape + parent-side trace merge).
 cluster-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_transport.py \
-		tests/test_transport_native.py -q -m 'not slow'
+		tests/test_transport_native.py tests/test_transport_proc.py \
+		-q -m 'not slow'
 
 # Traffic-plane tier (ISSUE 6): open-loop clients, mempool pacing/dedup,
 # WAN link shapes, submit→commit latency accounting, kill/restart

@@ -34,6 +34,23 @@ Perfetto / chrome://tracing; BENCH_OBS_PORT=<port> serves /metrics,
 /trace.json and /healthz live during the run (port echoed too; 0 picks
 a free one).  Native arms always carry their engine.cyc.<type> cycle
 splits in the JSON line.
+
+Round 14 — process-per-node arm: ``BENCH_PROC=1`` (or
+``BENCH_TCP_IMPL=native_proc``) runs one cluster_worker OS process per
+node (:class:`~hbbft_tpu.transport.proc_cluster.ProcCluster`, ephemeral
+port-0 ready-line handshake, presubmit drive) instead of 2N threads in
+this interpreter — the N=104 scale runs go through this arm.
+``BENCH_PROC=1 BENCH_TCP_IMPL=python`` selects Python-oracle workers
+(``python_proc``); ``BENCH_PROC_OBS=1`` gives every worker its own
+scrape endpoints.  The JSON
+line gains ``workers``/``ready_s``/``sha_identical`` (asserted across
+ALL worker summaries, not just node 0) and ``min_epoch_contribs`` (the
+non-empty-epochs check); ``batches_sha`` stays directly comparable with
+the thread arms at one seed.  BENCH_TRACE also works here: each worker
+dumps its trace file at exit and the parent merges them on the shared
+wall clock.  The vectored-egress A/B for any arm is
+``HBBFT_TPU_SENDMSG=0`` (buffered round-9 path) vs unset (sendmsg
+gather egress) on the same build; every line records the live setting.
 """
 
 from __future__ import annotations
@@ -53,6 +70,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hbbft_tpu.protocols.queueing_honey_badger import Input  # noqa: E402
 from hbbft_tpu.transport import LocalCluster  # noqa: E402
+from hbbft_tpu.transport.transport import _sendmsg_default  # noqa: E402
 from hbbft_tpu.utils import serde  # noqa: E402
 
 
@@ -106,6 +124,84 @@ def obs_extras(rec: dict, cluster, name: str, m=None) -> None:
         rec["trace_file"] = cluster.write_trace(path)
 
 
+def run_n_proc(
+    n: int, epochs: int, deadline_s: float, seed: int, impl: str = "native"
+) -> dict:
+    """One process-per-node measurement (``native_proc`` /
+    ``python_proc``): spawn the fleet, deliver the address map, let the
+    workers run the presubmit workload to ``epochs`` commits, and
+    aggregate their summaries."""
+    from hbbft_tpu.transport.proc_cluster import ProcCluster
+
+    trace_dir = os.environ.get("BENCH_TRACE")
+    t0 = time.perf_counter()
+    cluster = ProcCluster(
+        n,
+        seed=seed,
+        batch_size=8,
+        impl=impl,
+        epochs=epochs,
+        drive="presubmit",
+        timeout_s=deadline_s,
+        obs=os.environ.get("BENCH_PROC_OBS") == "1",
+        trace_dir=(
+            os.path.join(trace_dir, f"config6_n{n}_proc") if trace_dir else None
+        ),
+    )
+    rec = {
+        "config": "config6_tcp_cluster",
+        "nodes": n,
+        "suite": "scalar",
+        "transport": "tcp-localhost",
+        "node_impl": f"{impl}_proc",
+        "drive": "presubmit",
+        "seed": seed,
+        "workers": n,
+        "threads_per_node": 3,  # selector loop + engine sweep + driver
+        "vectored": _sendmsg_default(),
+        "target_epochs": epochs,
+    }
+    try:
+        cluster.start()
+        rec["ready_s"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        sums = cluster.join(timeout_s=deadline_s + 60.0)
+        wall = time.perf_counter() - t0
+        live = [s for s in sums.values() if s is not None]
+        shas = sorted({s["batches_sha"] for s in live})
+        committed = min((s["batches"] for s in live), default=0)
+        msgs = sum(s["msgs_handled"] for s in live)
+        rec.update(
+            {
+                "epochs_committed": committed,
+                "wall_s": round(wall, 2),
+                "epochs_per_s": round(committed / wall, 3) if wall else None,
+                "msgs_handled": msgs,
+                "msgs_per_s": round(msgs / wall, 1) if wall else None,
+                "batches_sha": shas[0] if len(shas) == 1 else None,
+                "sha_identical": len(shas) == 1 and len(live) == n,
+                "min_epoch_contribs": min(
+                    (min(s["epoch_contribs"], default=0) for s in live),
+                    default=0,
+                ),
+                "handler_errors": sum(s["handler_errors"] for s in live),
+                "protocol_faults": sum(s["faults"] for s in live),
+                "complete": all(
+                    s is not None and s["done"] for s in sums.values()
+                ),
+            }
+        )
+    finally:
+        cluster.stop()
+    if trace_dir:
+        merged = cluster.merged_chrome_trace()
+        path = os.path.join(trace_dir, f"config6_n{n}_native_proc.trace.json")
+        with open(path, "w") as fh:
+            json.dump(merged, fh)
+        rec["trace_file"] = path
+    return rec
+
+
 def run_n(
     n: int, epochs: int, deadline_s: float, impl: str, drive: str, seed: int
 ) -> dict:
@@ -124,6 +220,7 @@ def run_n(
         "seed": seed,
         "serde_native": serde._native_scan(serde.dumps(0)) is not None,
         "threads_per_node": 2,
+        "vectored": _sendmsg_default(),
         "target_epochs": epochs,
         "setup_s": round(setup_s, 3),
     }
@@ -202,9 +299,20 @@ def main() -> None:
     impl = os.environ.get("BENCH_TCP_IMPL", "python")
     drive = os.environ.get("BENCH_TCP_DRIVE", "presubmit")
     seed = int(os.environ.get("BENCH_TCP_SEED", "0"))
+    proc = (
+        os.environ.get("BENCH_PROC") == "1" or impl.endswith("_proc")
+    )
     preload_engine_serde()
     for n in ns:
-        print(json.dumps(run_n(n, epochs, deadline, impl, drive, seed)), flush=True)
+        if proc:
+            # BENCH_TCP_IMPL still selects the worker implementation in
+            # the proc arm: python → python_proc, anything else (the
+            # default, native, native_proc) → native_proc.
+            worker_impl = "python" if impl.startswith("python") else "native"
+            rec = run_n_proc(n, epochs, deadline, seed, impl=worker_impl)
+        else:
+            rec = run_n(n, epochs, deadline, impl, drive, seed)
+        print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

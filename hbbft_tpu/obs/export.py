@@ -253,3 +253,55 @@ def write_chrome_trace(
     with open(path, "w") as fh:
         json.dump(chrome_trace(tracks, pids), fh)
     return path
+
+
+def merge_chrome_traces(parts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge already-rendered Chrome traces from SEPARATE processes
+    into one (the process-per-node cluster: each worker dumps its own
+    node's trace; the parent merges).
+
+    Every per-process trace's timestamps are relative to its OWN
+    earliest event, but :func:`chrome_trace` records that absolute
+    anchor in ``otherData.t0_unix_s`` — since every event was stamped
+    with ``time.time()`` at emit, realigning each part by
+    ``(t0_part - t0_min)`` puts all processes on the shared wall clock
+    without re-timing anything.  Metadata records (``ph == "M"``,
+    always ts 0) are not shifted.  Pid collisions across parts are
+    remapped (workers pin pid = node id, so collisions only appear if
+    two parts carry the same node — e.g. a restart's second trace).
+    """
+    anchored = []
+    for p in parts:
+        if not isinstance(p, dict):
+            continue
+        evs = p.get("traceEvents") or []
+        t0 = float((p.get("otherData") or {}).get("t0_unix_s", 0.0))
+        anchored.append((evs, t0, any(ev.get("ph") != "M" for ev in evs)))
+    real_t0s = [t0 for _, t0, has_data in anchored if has_data]
+    t0_min = min(real_t0s) if real_t0s else 0.0
+    merged: List[Dict[str, Any]] = []
+    used: set = set()
+    for evs, t0, has_data in anchored:
+        pids = sorted({int(ev.get("pid", 0)) for ev in evs})
+        remap: Dict[int, int] = {}
+        for pid in pids:
+            new = pid
+            while new in used:
+                new = max(used) + 1
+            remap[pid] = new
+            used.add(new)
+        shift_us = (t0 - t0_min) * 1e6 if has_data else 0.0
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = remap.get(int(ev.get("pid", 0)), ev.get("pid", 0))
+            if ev.get("ph") != "M":
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
+            merged.append(ev)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "t0_unix_s": t0_min,
+            "source": "hbbft-tpu flight recorder (process merge)",
+        },
+    }

@@ -3,13 +3,17 @@
 The first subsystem that runs hbbft nodes over actual TCP connections
 instead of the in-process simulator: length-prefixed serde frames
 (:mod:`.framing`), a selectors-based per-node event loop with
-backpressure and reconnect (:mod:`.transport`), a thread-per-node /
-subprocess cluster harness (:mod:`.cluster`), and a deterministic
-byte-level fault injector (:mod:`.faults`).  See docs/TRANSPORT.md.
+backpressure, reconnect, and sendmsg vectored egress
+(:mod:`.transport`), a thread-per-node cluster harness
+(:mod:`.cluster`), a process-per-node runtime (:mod:`.proc_cluster`
+over :mod:`.cluster_worker` — ``node_impl="native_proc"``), and a
+deterministic byte-level fault injector (:mod:`.faults`).  See
+docs/TRANSPORT.md.
 """
 
 from hbbft_tpu.transport.cluster import ClusterNode, LocalCluster
 from hbbft_tpu.transport.native_node import NativeClusterNode
+from hbbft_tpu.transport.proc_cluster import ProcCluster
 from hbbft_tpu.transport.faults import (
     FaultInjector,
     FaultStats,

@@ -118,6 +118,41 @@ def track_commits(
     return last_t
 
 
+def merge_node_metrics(
+    nodes: Dict[int, Any],
+    base: Optional[Metrics] = None,
+    phases: Optional[Dict[str, Tuple[Dict[float, float], int, float]]] = None,
+) -> Metrics:
+    """Merge per-node metrics plus the derived observability families
+    (per-node transport export, ``epoch.latency`` summary, per-node
+    committed gauges, ``phase.*`` summaries) — the shared half of
+    :meth:`LocalCluster.merged_metrics`, factored out so the
+    process-per-node worker (:mod:`~hbbft_tpu.transport.cluster_worker`)
+    exports the same metric families for ONE node that a cluster dump
+    carries for N, and the parent-side merge stays a plain counter sum."""
+    m = Metrics()
+    for node in nodes.values():
+        node.transport.export_metrics()
+        m.merge(node.metrics)
+    if base is not None:
+        m.merge(base)
+    lats: List[float] = []
+    for i, node in nodes.items():
+        tracker = getattr(node, "epochs", None)
+        if tracker is None:
+            continue
+        node_lats = tracker.latencies()
+        lats.extend(node_lats)
+        m.gauge(f"epoch.{i}.committed", len(node_lats))
+    sm = summarize(lats)
+    if sm is not None:
+        quant, count, total = sm
+        m.summary("epoch.latency", quant, count, total)
+    for phase, (quant, count, total) in sorted((phases or {}).items()):
+        m.summary(f"phase.{phase}", quant, count, total)
+    return m
+
+
 class ClusterNode:
     """One node: protocol thread + transport, joined by an inbox."""
 
@@ -708,35 +743,6 @@ class LocalCluster:
         summaries.  ``fresh=True`` bypasses the phase-summary TTL cache
         — end-of-run snapshots (benchmark JSON lines) must be exact
         even when a live scraper primed the cache seconds earlier."""
-        m = Metrics()
-        for node in self.nodes.values():
-            node.transport.export_metrics()
-            m.merge(node.metrics)
-        m.merge(self.metrics)
-        if self.injector is not None:
-            # injected-fault totals land in the same Prometheus dump as
-            # the transport/cluster counters (faults.* gauges)
-            self.injector.export_metrics(m)
-        if self.crypto_service is not None:
-            # crypto.* service plane (round 13): flush count/latency,
-            # batch-size summary, queue depth, fallback totals
-            self.crypto_service.export_metrics(m)
-        # epoch.latency (round 12): commit-to-commit latency across every
-        # node's tracker, as one Prometheus summary (replaces the ad-hoc
-        # per-benchmark epoch math); per-node committed counts ride as
-        # gauges next to the transport's per-peer series.
-        lats: List[float] = []
-        for i, node in self.nodes.items():
-            tracker = getattr(node, "epochs", None)
-            if tracker is None:
-                continue
-            node_lats = tracker.latencies()
-            lats.extend(node_lats)
-            m.gauge(f"epoch.{i}.committed", len(node_lats))
-        sm = summarize(lats)
-        if sm is not None:
-            quant, count, total = sm
-            m.summary("epoch.latency", quant, count, total)
         # phase.* (round 12): the per-epoch phase-latency breakdown
         # derived from the flight-recorder rings (rbc / ba / coin /
         # decrypt / epoch spans — obs/export.py), TTL-cached so a
@@ -750,8 +756,18 @@ class LocalCluster:
         else:
             phases = phase_summaries(self.trace_events())
             self._phase_cache = (now + 2.0, phases)
-        for phase, (quant, count, total) in sorted(phases.items()):
-            m.summary(f"phase.{phase}", quant, count, total)
+        # epoch.latency + per-node export (round 12) via the shared
+        # merge helper; the cluster-only extras (injector, crypto
+        # service) layer on top.
+        m = merge_node_metrics(self.nodes, base=self.metrics, phases=phases)
+        if self.injector is not None:
+            # injected-fault totals land in the same Prometheus dump as
+            # the transport/cluster counters (faults.* gauges)
+            self.injector.export_metrics(m)
+        if self.crypto_service is not None:
+            # crypto.* service plane (round 13): flush count/latency,
+            # batch-size summary, queue depth, fallback totals
+            self.crypto_service.export_metrics(m)
         return m
 
     def trace_events(self) -> Dict[str, List[TraceEvent]]:

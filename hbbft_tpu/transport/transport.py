@@ -28,6 +28,12 @@ threshold-service deployments, PAPERS.md):
   yet written when a connection dies are re-sent on the next connect
   (bytes already in the kernel buffer of a dead peer are gone — that is
   the loss window a mid-epoch crash produces).
+* **Vectored egress (round 14):** when the platform has
+  ``socket.sendmsg`` (and ``HBBFT_TPU_SENDMSG`` != 0), outbound bursts
+  leave as ONE gather syscall over the queue's own frame bytes instead
+  of a per-frame copy into ``sendbuf``; partial sends fall back to the
+  buffered path with identical ``pending_write``/``write_prog``/ACK
+  accounting (see :meth:`TcpTransport._flush_outbound_vectored`).
 * **Reconnect:** failed dials retry with exponential backoff + jitter
   (``backoff_base_s * 2^attempts`` capped at ``backoff_cap_s``, times
   ``1 + jitter * u``), seeded per node for reproducible tests.
@@ -56,6 +62,7 @@ from __future__ import annotations
 import collections
 import heapq
 import itertools
+import os
 import random
 import selectors
 import socket
@@ -163,6 +170,24 @@ SEND_COALESCE = RECV_CHUNK
 ACK_EVERY = 64
 ACK_DELAY_S = 0.02
 
+#: Vectored egress (round 14): one ``sendmsg()`` over the pending frame
+#: list replaces the per-frame copy into ``sendbuf`` — MSG bursts leave
+#: as a gather array of the frame bytes the queue already holds.  The
+#: buffered ``sendbuf`` path remains the fallback for partial sends
+#: (the unsent tail is retained there, byte-identical accounting) and
+#: for platforms without ``socket.sendmsg``.  ``HBBFT_TPU_SENDMSG=0``
+#: forces the buffered path on the same build (the A/B switch).
+SENDMSG_AVAILABLE = hasattr(socket.socket, "sendmsg")
+
+#: Gather-array length cap per sendmsg call.  Linux UIO_MAXIOV is 1024;
+#: half that leaves headroom for any platform with a smaller limit
+#: while still covering a whole SEND_COALESCE window of small frames.
+SENDMSG_MAX_BUFS = 512
+
+
+def _sendmsg_default() -> bool:
+    return SENDMSG_AVAILABLE and os.environ.get("HBBFT_TPU_SENDMSG", "1") != "0"
+
 
 class _Outbound:
     """Dialer-side state toward one peer.
@@ -269,6 +294,7 @@ class TcpTransport:
         ban_threshold: int = 3,
         ban_base_s: float = 0.25,
         ban_cap_s: float = 2.0,
+        vectored: Optional[bool] = None,
     ) -> None:
         self.node_id = node_id
         self.cluster_id = cluster_id
@@ -306,6 +332,13 @@ class TcpTransport:
         self.ban_threshold = ban_threshold
         self.ban_base_s = ban_base_s
         self.ban_cap_s = ban_cap_s
+        # Vectored egress (round 14): None = auto (on when the platform
+        # has sendmsg and HBBFT_TPU_SENDMSG != 0).  Explicit True on a
+        # sendmsg-less platform is downgraded, not an error — the two
+        # paths are output-identical by construction.
+        if vectored is None:
+            vectored = _sendmsg_default()
+        self.vectored = bool(vectored) and SENDMSG_AVAILABLE
         self._bans: Dict[Any, _BanState] = {}
         # Flight recorder (round 12): an optional TraceBuffer the owner
         # (LocalCluster) installs; connect/disconnect/ban milestones land
@@ -761,6 +794,9 @@ class TcpTransport:
     def _flush_outbound(self, dest: Any, ob: _Outbound) -> None:
         if ob.state != "connected" or ob.sock is None:
             return
+        if self.vectored:
+            self._flush_outbound_vectored(dest, ob)
+            return
         st = self.peer_stats[dest]
         while ob.sendbuf or (ob.queue and not ob.await_ack):
             # Pack a burst of frames into the write buffer before the
@@ -799,6 +835,83 @@ class TcpTransport:
                 ob.pending_write_bytes -= len(orig)
                 ob.inflight.append(orig)
                 ob.inflight_bytes += len(orig)
+        st.queue_frames = len(ob.queue)
+        st.queue_bytes = ob.queue_bytes
+        self._want_write(ob, bool(ob.sendbuf or (ob.queue and not ob.await_ack)))
+
+    def _flush_outbound_vectored(self, dest: Any, ob: _Outbound) -> None:
+        """sendmsg gather egress: frames go on the wire straight from
+        the queue's bytes objects — no per-frame copy into ``sendbuf``.
+
+        Accounting is IDENTICAL to the buffered path: each gathered
+        frame appends ``(wire_len, orig)`` to ``pending_write`` before
+        the syscall, ``write_prog`` counts accepted bytes, and the
+        graduate loop promotes fully-covered frames to ``inflight``.
+        The one structural difference is where unsent bytes live: the
+        kernel accepting a PARTIAL gather leaves the tail with no
+        backing buffer, so the remainder is copied into ``sendbuf`` and
+        the next flush (still this method) drains ``sendbuf`` first —
+        the copy only happens on kernel pushback, where the buffered
+        path would have paid it up front on every frame.
+        """
+        st = self.peer_stats[dest]
+        while ob.sendbuf or (ob.queue and not ob.await_ack):
+            bufs: List[Any] = []
+            total = 0
+            if ob.sendbuf:
+                bufs.append(ob.sendbuf)
+                total = len(ob.sendbuf)
+            while (
+                ob.queue
+                and not ob.await_ack
+                and total < SEND_COALESCE
+                and len(bufs) < SENDMSG_MAX_BUFS
+            ):
+                orig, wire = ob.queue.popleft()
+                ob.queue_bytes -= len(orig)
+                data = wire if wire is not None else orig
+                bufs.append(data)
+                total += len(data)
+                ob.pending_write.append((len(data), orig))
+                ob.pending_write_bytes += len(orig)
+                st.frames_out += 1
+            try:
+                n = ob.sock.sendmsg(bufs)
+            except BlockingIOError:
+                n = 0
+            except OSError:
+                self._drop_outbound(dest, ob, redial=True)
+                return
+            if n:
+                st.bytes_out += n
+                ob.write_prog += n
+                while (
+                    ob.pending_write
+                    and ob.write_prog >= ob.pending_write[0][0]
+                ):
+                    wire_len, orig = ob.pending_write.popleft()
+                    ob.write_prog -= wire_len
+                    if orig is None:  # handshake sentinel
+                        continue
+                    ob.pending_write_bytes -= len(orig)
+                    ob.inflight.append(orig)
+                    ob.inflight_bytes += len(orig)
+            if n < total:
+                # Kernel pushback: retain the unsent tail in sendbuf so
+                # the resume accounting sees exactly the bytes a
+                # buffered flush would still be holding, then stop and
+                # arm write interest.
+                rem = n
+                tail = bytearray()
+                for b in bufs:
+                    if rem >= len(b):
+                        rem -= len(b)
+                        continue
+                    tail += memoryview(b)[rem:]
+                    rem = 0
+                ob.sendbuf = tail
+                break
+            ob.sendbuf = bytearray()
         st.queue_frames = len(ob.queue)
         st.queue_bytes = ob.queue_bytes
         self._want_write(ob, bool(ob.sendbuf or (ob.queue and not ob.await_ack)))
