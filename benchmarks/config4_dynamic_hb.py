@@ -145,15 +145,17 @@ def main_native() -> None:
         "delivered_msgs": nat.delivered,
     }
     if os.environ.get("BENCH_PROF"):
-        # Continuation-tail split in Gcyc (hbe_prof_cycles — the A/B
-        # currency per the clock-drift rule in CLAUDE.md): 14 = all
-        # pool-flush continuations, 13 = the > 1M-cycle tail, 11 = max
-        # single continuation, 12/15 = Python batch_cb / contrib_cb
-        # wall (the round-6 batch-digest split).
+        # Era-change split in Gcyc (hbe_prof_cycles — the A/B currency
+        # per the clock-drift rule in CLAUDE.md), slots per
+        # tools/lint/slot_registry.py: 11 = RLC group stats, 12/15 =
+        # Python batch_cb / contrib_cb wall (the round-6 batch-digest
+        # split), 13 = epoch-advance wall, 14 = the SIMD combine-kernel
+        # wall (round 15; the old round-4 continuation-split names died
+        # with their slots — don't compare against round-4/5 numbers).
         lib, h = nat.lib, nat.handle
         prof = {}
         for slot, name in (
-            (14, "cont_total"), (13, "cont_tail_gt1m"), (11, "cont_max"),
+            (14, "combine_kernel"), (13, "epoch_advance"), (11, "rlc_groups"),
             (12, "batch_cb"), (15, "contrib_cb"),
         ):
             prof[name + "_gcyc"] = round(

@@ -89,6 +89,28 @@ def preload_engine_serde() -> bool:
     return serde._native_scan(serde.dumps(0)) is not None
 
 
+def _engine_build_fields(n: int) -> dict:
+    """Engine-build self-description for the JSON lines (round 15):
+    SIMD dispatch arm + NodeSet width, so A/B rows name their arms per
+    the CLAUDE.md clock-drift rules.  Uses the width THIS n selects
+    (native nodes — in-process or proc-mode workers, which run the same
+    loader — pick the -DHBE_WORDS build via _words_for), not the
+    default build.  Empty when no engine lib loads (pure-Python arms
+    still decode via it when present)."""
+    try:
+        from hbbft_tpu import native_engine
+
+        lib = native_engine.get_lib(native_engine._words_for(n))
+        if lib is None:
+            return {}
+        return {
+            "simd": native_engine.simd_mode(lib),
+            "hbe_words": int(lib.hbe_words()),
+        }
+    except Exception:
+        return {}
+
+
 def resolve_impl(impl: str, n: int):
     """"mixed" = alternate node arms (even ids python, odd native), so
     one cluster/trace carries both impls."""
@@ -161,6 +183,7 @@ def run_n_proc(
         "vectored": _sendmsg_default(),
         "target_epochs": epochs,
     }
+    rec.update(_engine_build_fields(n))
     try:
         cluster.start()
         rec["ready_s"] = round(time.perf_counter() - t0, 2)
@@ -224,6 +247,7 @@ def run_n(
         "target_epochs": epochs,
         "setup_s": round(setup_s, 3),
     }
+    rec.update(_engine_build_fields(n))
     if drive == "presubmit":
         # Deterministic workload BEFORE start: every node sees the
         # identical txn queue in every arm, so the first `epochs`

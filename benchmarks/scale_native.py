@@ -26,6 +26,19 @@ delivery caches and lag BA rounds; 0 = queue-dry measured WORSE at
 N=300, BASELINE.md round 7).  The old path is eager-only, so the knob
 is ignored there.
 
+Round 15: the JSON line also carries the engine build's SIMD dispatch
+arm (``simd``: ifma/scalar), the NodeSet width (``hbe_words``), and the
+slot-14 combine-kernel stats, so the vectorized-field-plane A/B is two
+self-describing runs:
+
+    HBBFT_TPU_SIMD=0 python benchmarks/scale_native.py   # scalar arm
+    HBBFT_TPU_SIMD=1 python benchmarks/scale_native.py   # IFMA arm
+
+Adjudicate per the BASELINE round-8 format: alternate the arms
+back-to-back on a quiet box, compare COIN/DECRYPT cyc/delivery and
+``combine_kernel`` cycles/count, and control-correct with the untouched
+BVAL slot.
+
 Env: SCALE_NS (comma list, default "300,512"), SCALE_BUDGET_S per N
 (default 5400), SCALE_WINDOW (rate-window deliveries, default 30M),
 SCALE_FLUSH_EVERY (RLC arm only; default 5000).
@@ -68,6 +81,11 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
         "rbc_codec": "gf2^16" if n > 255 else "gf256",
         "rlc": rlc_on,
         "flush_every": nat.flush_every,
+        # Engine-build self-description (round 15): the SIMD dispatch arm
+        # and NodeSet width, so A/B rows are self-describing per the
+        # CLAUDE.md clock-drift rules.
+        "simd": native_engine.simd_mode(nat.lib),
+        "hbe_words": int(nat.lib.hbe_words()),
         "setup_s": round(setup_s, 2),
     }
     chunk = 2_000_000
@@ -121,6 +139,10 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
         if prof[name]["count"]
     }
     rec["rlc_groups"] = prof["rlc_groups"]
+    # The COIN/DECRYPT combine component (slot 14, round 15): the
+    # direct readout for the HBBFT_TPU_SIMD A/B — cycles/combine on the
+    # Lagrange-coefficients + combine-sum kernel.
+    rec["combine_kernel"] = prof["combine_kernel"]
     if os.environ.get("SCALE_METRICS"):
         # Metrics-framework snapshot (counters/gauges; same shape the
         # TCP transport exports) — SCALE_METRICS=prom dumps Prometheus

@@ -178,9 +178,18 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     else:
         from hbbft_tpu.ops.native import build_and_load
 
+        # The vectorized field plane (ISSUE 14): field_ifma.cpp is the
+        # only unit compiled with -mavx512ifma (dropped automatically on
+        # toolchains without it — the stub arm compiles instead, and the
+        # runtime dispatch keeps scalar); field_plane.h is a header dep
+        # of engine.cpp, so edits rebuild every width.
+        native_dir = os.path.dirname(_SRC)
         lib = build_and_load(
             _SRC, _SO_TMPL.format(w=words),
             extra_flags=(f"-DHBE_WORDS={words}",),
+            aux_sources=(os.path.join(native_dir, "field_ifma.cpp"),),
+            aux_flags=("-mavx512ifma",),
+            extra_deps=(os.path.join(native_dir, "field_plane.h"),),
         )
     if lib is None:
         return None
@@ -358,6 +367,24 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_dkg_row_evals.argtypes = [
         cp, ctypes.c_int32, ctypes.c_int32, u8p,
     ]
+    # SIMD field-plane dispatch + kernel fuzz surface (ISSUE 14):
+    # hbe_simd_mode reports the resolved arm (1 = AVX-512 IFMA, 0 =
+    # scalar), hbe_simd_force pins it in-process for both-arm
+    # equivalence tests (-1 = back to HBBFT_TPU_SIMD/auto).
+    for name in ("hbe_simd_mode", "hbe_simd_compiled"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int32
+        fn.argtypes = []
+    lib.hbe_simd_force.restype = ctypes.c_int32
+    lib.hbe_simd_force.argtypes = [ctypes.c_int32]
+    lib.hbe_field_mul_batch.restype = None
+    lib.hbe_field_mul_batch.argtypes = [cp, cp, ctypes.c_int32, u8p]
+    lib.hbe_field_dot.restype = None
+    lib.hbe_field_dot.argtypes = [cp, cp, ctypes.c_int32, u8p]
+    lib.hbe_field_lagrange.restype = None
+    lib.hbe_field_lagrange.argtypes = [i32p, ctypes.c_int32, u8p]
+    lib.hbe_field_rlc_accum.restype = None
+    lib.hbe_field_rlc_accum.argtypes = [cp, cp, ctypes.c_int32, u8p]
     lib.hbe_flush.restype = None
     lib.hbe_flush.argtypes = [ctypes.c_void_p]
     lib.hbe_ret_bytes.restype = None
@@ -390,6 +417,17 @@ def get_lib(words: int = 4) -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def simd_mode(lib: Optional[ctypes.CDLL] = None) -> str:
+    """Resolved field-plane dispatch arm of the (default) engine build:
+    ``"ifma"`` or ``"scalar"``.  Benchmarks stamp this into their JSON
+    lines so A/B rows are self-describing (CLAUDE.md clock-drift
+    rules)."""
+    lib = lib if lib is not None else get_lib()
+    if lib is None:
+        return "unavailable"
+    return "ifma" if lib.hbe_simd_mode() else "scalar"
 
 
 _SCHED_KINDS = {"always": 0, "never": 1, "every_nth": 2, "tick_tock": 3}
@@ -693,7 +731,7 @@ class _EngineNetBase:
             (11, "rlc_groups"),
             (12, "batch_cb"),
             (13, "epoch_advance"),
-            (14, "pool_flush"),
+            (14, "combine_kernel"),  # round 15: the SIMD combine wall
             (15, "contrib_cb"),
         ):
             out[name] = {
@@ -1054,8 +1092,18 @@ class NativeQhbNet(_EngineNetBase):
         adversary: Any = None,
         threads: int = 1,
         rlc: Optional[bool] = None,
+        engine_words: Optional[int] = None,
     ) -> None:
-        lib = get_lib(_words_for(n))
+        # engine_words forces a wider NodeSet build than the network
+        # needs (e.g. the -DHBE_WORDS=8 era-change smoke test runs a
+        # small N on the wide build to pin width-independence).
+        words = engine_words if engine_words is not None else _words_for(n)
+        if words < _words_for(n):
+            raise ValueError(
+                f"engine_words={words} cannot serve n={n} "
+                f"(needs {_words_for(n)})"
+            )
+        lib = get_lib(words)
         if lib is None:
             raise RuntimeError("native engine unavailable (no compiler?)")
         self.lib = lib

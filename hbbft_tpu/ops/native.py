@@ -15,7 +15,9 @@ Correctness is pinned by tests comparing both paths bit-for-bit
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
+import re
 import subprocess
 from typing import Dict, List, Optional, Sequence
 
@@ -26,22 +28,93 @@ _SRC = os.path.join(_ROOT, "native", "hbbft_native.cpp")
 _SO = os.path.join(_ROOT, "native", "build", "libhbbft_native.so")
 
 
+@functools.lru_cache(maxsize=None)
+def _flags_supported(flags: tuple) -> bool:
+    """Probe whether g++ accepts ``flags`` (against an empty input, the
+    same probe as the Makefile's IFMA_FLAG) — the ISA feature gate.
+    Probing, rather than retrying a failed real compile without the
+    flags, keeps a genuine source error in the gated arm LOUD instead
+    of silently building the stub arm."""
+    if not flags:
+        return True
+    try:
+        subprocess.run(
+            ["g++", *flags, "-x", "c++", "-c", os.devnull, "-o", os.devnull],
+            check=True, capture_output=True, timeout=60,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _build_aux_object(src: str, obj_stem: str, deps: Sequence[str],
+                      preferred_flags: Sequence[str],
+                      timeout: int) -> Optional[str]:
+    """Compile ``src`` to an object file if stale and return its path
+    (None on failure).  ``preferred_flags`` are used iff the toolchain's
+    probe accepts them (e.g. ``-mavx512ifma``; without it the source
+    compiles its stub arm) — the flag OUTCOME is encoded in the object
+    filename, so a toolchain upgrade or flag change triggers a rebuild
+    instead of linking a stale stub object forever."""
+    use_flags = (
+        tuple(preferred_flags) if _flags_supported(tuple(preferred_flags))
+        else ()
+    )
+    tag = (
+        re.sub(r"[^A-Za-z0-9]+", "_", " ".join(use_flags)).strip("_")
+        if use_flags else "plain"
+    )
+    obj = f"{obj_stem}.{tag}.o"
+
+    def _mtime(path: str) -> float:
+        return os.path.getmtime(path) if os.path.exists(path) else 0.0
+
+    stale = not os.path.exists(obj) or max(
+        _mtime(src), *(_mtime(d) for d in deps)
+    ) > os.path.getmtime(obj)
+    if not stale:
+        return obj
+    try:
+        os.makedirs(os.path.dirname(obj), exist_ok=True)
+        tmp = f"{obj}.{os.getpid()}.tmp.o"
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-std=c++17", "-c", *use_flags,
+             "-o", tmp, src],
+            check=True, capture_output=True, timeout=timeout,
+        )
+        os.replace(tmp, obj)
+        return obj
+    except Exception:
+        return None
+
+
 def build_and_load(
     src: str, so: str, timeout: int = 300,
     extra_flags: Sequence[str] = (),
+    aux_sources: Sequence[str] = (),
+    aux_flags: Sequence[str] = (),
+    extra_deps: Sequence[str] = (),
 ) -> Optional[ctypes.CDLL]:
     """Compile ``src`` into ``so`` if stale and dlopen it; None on any
     failure (callers fall back to pure-Python paths).
 
     Staleness tracks the source AND the shared sha3_gf.h header (both
-    native libraries include it; a header edit must rebuild both).  The
-    build lands in a process-unique temp path then atomically renames:
-    other processes may have the current .so mapped, and a concurrent
-    importer must never CDLL a half-written file.
+    native libraries include it; a header edit must rebuild both), plus
+    any ``extra_deps`` and aux objects.  The build lands in a
+    process-unique temp path then atomically renames: other processes
+    may have the current .so mapped, and a concurrent importer must
+    never CDLL a half-written file.
 
     ``extra_flags``: additional g++ flags (e.g. the engine's
     ``-DHBE_WORDS=N`` NodeSet-width parameter); callers must encode
     flag-relevant state in the ``so`` filename.
+
+    ``aux_sources``: extra translation units compiled to objects with
+    ``aux_flags`` when the toolchain's probe accepts them (dropped
+    otherwise — the ISA feature gate for the engine's AVX-512 IFMA
+    field-plane arm; the flag outcome is baked into the object name).
+    Objects are shared across flag variants of the same ``src`` (they
+    must not depend on ``extra_flags``).
     """
     if os.environ.get("HBBFT_TPU_NO_NATIVE"):
         return None
@@ -50,13 +123,26 @@ def build_and_load(
         return os.path.getmtime(path) if os.path.exists(path) else 0.0
 
     header = os.path.join(os.path.dirname(src), "sha3_gf.h")
-    if not os.path.exists(so) or max(_mtime(src), _mtime(header)) > os.path.getmtime(so):
+    deps = [header, *extra_deps]
+    objs = []
+    for aux in aux_sources:
+        stem = os.path.join(
+            os.path.dirname(so),
+            os.path.splitext(os.path.basename(aux))[0],
+        )
+        obj = _build_aux_object(aux, stem, deps, aux_flags, timeout)
+        if obj is None:
+            return None
+        objs.append(obj)
+    newest = max(_mtime(src), *(_mtime(d) for d in deps),
+                 *(_mtime(o) for o in objs)) if (deps or objs) else _mtime(src)
+    if not os.path.exists(so) or newest > os.path.getmtime(so):
         try:
             os.makedirs(os.path.dirname(so), exist_ok=True)
             tmp = f"{so}.{os.getpid()}.tmp"
             subprocess.run(
                 ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
-                 *extra_flags, "-o", tmp, src],
+                 *extra_flags, "-o", tmp, src, *objs],
                 check=True,
                 capture_output=True,
                 timeout=timeout,

@@ -38,6 +38,7 @@
 #include <thread>
 #include <vector>
 
+#include "field_plane.h"
 #include "sha3_gf.h"
 #include <chrono>
 
@@ -59,6 +60,11 @@ namespace {
 
 // ===========================================================================
 // 256-bit arithmetic mod r (BLS12-381 scalar field order)
+//
+// The primitive implementations live in native/field_plane.h (round 15):
+// the shared scalar Montgomery core plus the dispatched batch kernels
+// (AVX-512 IFMA arm in native/field_ifma.cpp, HBBFT_TPU_SIMD switch).
+// The U256 wrappers below keep the engine's historical names.
 // ===========================================================================
 
 struct U256 {
@@ -78,127 +84,85 @@ const U256 R_MINUS_1 = {{0xFFFFFFFF00000000ULL, 0x53BDA402FFFE5BFEULL,
                          0x3339D80809A1D805ULL, 0x73EDA753299D7D48ULL}};
 
 inline int u256_cmp(const U256& a, const U256& b) {
-  for (int i = 3; i >= 0; --i) {
-    if (a.w[i] < b.w[i]) return -1;
-    if (a.w[i] > b.w[i]) return 1;
-  }
-  return 0;
+  return hbf::cmp4(a.w, b.w);
 }
 
-inline bool u256_is_zero(const U256& a) {
-  return (a.w[0] | a.w[1] | a.w[2] | a.w[3]) == 0;
-}
+inline bool u256_is_zero(const U256& a) { return hbf::is_zero4(a.w); }
 
 // a + b with carry out (no reduction)
 inline uint64_t u256_add_raw(const U256& a, const U256& b, U256& out) {
-  unsigned __int128 c = 0;
-  for (int i = 0; i < 4; ++i) {
-    c += (unsigned __int128)a.w[i] + b.w[i];
-    out.w[i] = (uint64_t)c;
-    c >>= 64;
-  }
-  return (uint64_t)c;
+  return hbf::add4_raw(a.w, b.w, out.w);
 }
 
 // a - b with borrow out
 inline uint64_t u256_sub_raw(const U256& a, const U256& b, U256& out) {
-  unsigned __int128 borrow = 0;
-  for (int i = 0; i < 4; ++i) {
-    unsigned __int128 d =
-        (unsigned __int128)a.w[i] - b.w[i] - (uint64_t)borrow;
-    out.w[i] = (uint64_t)d;
-    borrow = (d >> 64) ? 1 : 0;
-  }
-  return (uint64_t)borrow;
+  return hbf::sub4_raw(a.w, b.w, out.w);
 }
 
 inline U256 addmod(const U256& a, const U256& b) {
-  U256 s;
-  uint64_t carry = u256_add_raw(a, b, s);
-  U256 t;
-  uint64_t borrow = u256_sub_raw(s, R_MOD, t);
-  return (carry || !borrow) ? t : s;
+  U256 o;
+  hbf::addmod4(a.w, b.w, o.w);
+  return o;
 }
 
 inline U256 submod(const U256& a, const U256& b) {
-  U256 d;
-  uint64_t borrow = u256_sub_raw(a, b, d);
-  if (borrow) {
-    U256 e;
-    u256_add_raw(d, R_MOD, e);
-    return e;
-  }
-  return d;
+  U256 o;
+  hbf::submod4(a.w, b.w, o.w);
+  return o;
 }
 
-// Montgomery: NPRIME = -r^{-1} mod 2^64; R2 = 2^512 mod r.
-// Values are stored CANONICAL; mulmod converts through Montgomery twice.
-const uint64_t R_NPRIME = 0xFFFFFFFEFFFFFFFFULL;  // -(r^-1) mod 2^64
-// 2^512 mod r:
-const U256 R2_MOD = {{0xC999E990F3F29C6DULL, 0x2B6CEDCB87925C23ULL,
-                      0x05D314967254398FULL, 0x0748D9D99F59FF11ULL}};
+// Montgomery machinery (field_plane.h): engine state stays CANONICAL at
+// rest, but every batch loop runs in the Montgomery domain end-to-end
+// and converts once at its boundaries (round 15) — the classic two-REDC
+// mulmod below is for one-shot call sites only.
 
 // REDC: given T (8 words, value < r * 2^256), returns T * 2^-256 mod r.
 inline U256 redc(const uint64_t t_in[8]) {
-  uint64_t t[9];
-  std::memcpy(t, t_in, 8 * sizeof(uint64_t));
-  t[8] = 0;
-  for (int i = 0; i < 4; ++i) {
-    uint64_t m = t[i] * R_NPRIME;
-    unsigned __int128 c = 0;
-    for (int j = 0; j < 4; ++j) {
-      c += (unsigned __int128)m * R_MOD.w[j] + t[i + j];
-      t[i + j] = (uint64_t)c;
-      c >>= 64;
-    }
-    for (int j = i + 4; j < 9 && c; ++j) {
-      c += t[j];
-      t[j] = (uint64_t)c;
-      c >>= 64;
-    }
-  }
-  U256 res = {{t[4], t[5], t[6], t[7]}};
-  if (t[8] || u256_cmp(res, R_MOD) >= 0) {
-    U256 out;
-    u256_sub_raw(res, R_MOD, out);
-    return out;
-  }
-  return res;
+  U256 o;
+  hbf::redc256(t_in, o.w);
+  return o;
 }
 
 inline void u256_mul_raw(const U256& a, const U256& b, uint64_t out[8]) {
-  std::memset(out, 0, 8 * sizeof(uint64_t));
-  for (int i = 0; i < 4; ++i) {
-    unsigned __int128 c = 0;
-    for (int j = 0; j < 4; ++j) {
-      c += (unsigned __int128)a.w[i] * b.w[j] + out[i + j];
-      out[i + j] = (uint64_t)c;
-      c >>= 64;
-    }
-    out[i + 4] = (uint64_t)c;
-  }
+  hbf::mul4_raw(a.w, b.w, out);
 }
 
 inline U256 mulmod(const U256& a, const U256& b) {
-  uint64_t t[8];
-  u256_mul_raw(a, b, t);
-  U256 m = redc(t);  // a*b*2^-256
-  u256_mul_raw(m, R2_MOD, t);
-  return redc(t);  // a*b mod r
+  U256 o;
+  hbf::mulmod4(a.w, b.w, o.w);
+  return o;
 }
 
+// One-REDC Montgomery product a*b*2^-256 (canonical; one side < r).
+inline U256 mont_mul(const U256& a, const U256& b) {
+  U256 o;
+  hbf::mont_mul4(a.w, b.w, o.w);
+  return o;
+}
+
+inline U256 to_mont(const U256& a) {
+  U256 o;
+  hbf::to_mont4(a.w, o.w);
+  return o;
+}
+
+inline U256 from_mont(const U256& a) {
+  U256 o;
+  hbf::from_mont4(a.w, o.w);
+  return o;
+}
+
+// 2^256 mod r — the field plane's copy is the source of truth (the
+// deliberate-duplication COMDAT rule covers field_ifma.cpp only).
+const U256 ONE_MONT = {{hbf::ONE_M256[0], hbf::ONE_M256[1],
+                        hbf::ONE_M256[2], hbf::ONE_M256[3]}};
+
 inline U256 invmod(const U256& a) {
-  // Fermat: a^(r-2).  Fine at per-combine volume.
-  U256 e;
-  u256_sub_raw(R_MOD, {{2, 0, 0, 0}}, e);
-  U256 result = {{1, 0, 0, 0}};
-  U256 base = a;
-  for (int i = 0; i < 255; ++i) {
-    int word = i / 64, bit = i % 64;
-    if ((e.w[word] >> bit) & 1) result = mulmod(result, base);
-    base = mulmod(base, base);
-  }
-  return result;
+  // Fermat a^(r-2), run inside the Montgomery domain (one REDC per
+  // ladder step instead of the classic ladder's two).
+  U256 am = to_mont(a), im;
+  hbf::mont_inv4(am.w, im.w);
+  return from_mont(im);
 }
 
 inline void u256_to_be32(const U256& a, uint8_t out[32]) {
@@ -330,38 +294,47 @@ inline std::shared_ptr<const std::vector<U256>> lagrange_cached(
     const std::vector<int>& idxs);
 
 inline std::vector<U256> lagrange(const std::vector<int>& idxs) {
+  // Round 15: the whole computation runs in the Montgomery domain
+  // (field_plane.h) — one REDC per product instead of the classic
+  // two — and the O(k^2) denominator half goes through the dispatched
+  // batch kernel (8-lane IFMA when available).  Outputs are the exact
+  // canonical coefficients the classic form produced (the domain map
+  // x -> x*2^256 is a ring isomorphism; every value converts back at
+  // the boundary), so lagrange_cached entries stay arm-independent.
   size_t k = idxs.size();
-  std::vector<U256> xs(k), nums(k), dens(k), coeffs(k);
-  for (size_t i = 0; i < k; ++i) xs[i] = {{(uint64_t)(idxs[i] + 1), 0, 0, 0}};
+  std::vector<U256> coeffs(k);
+  std::vector<int64_t> xs64(k);
+  for (size_t i = 0; i < k; ++i) xs64[i] = idxs[i] + 1;
+  std::vector<U256> dens(k);
+  hbf::lagrange_dens(xs64.data(), k, dens.empty() ? nullptr : dens[0].w);
   // nums via prefix/suffix products: num_i = Π_{j!=i} x_j in O(k)
   // (the old per-i inner loop was half the O(k^2) mulmods of a miss —
   // at t+1 = 100 a cache miss was ~2.7M cycles, round-7 combine
-  // profile).  dens keep the O(k^2) loop: each factor depends on i.
+  // profile).
+  std::vector<U256> xs_m(k), nums_m(k);
+  for (size_t i = 0; i < k; ++i) {
+    U256 x = {{(uint64_t)xs64[i], 0, 0, 0}};
+    xs_m[i] = to_mont(x);
+  }
   {
     std::vector<U256> pre(k + 1), suf(k + 1);
-    pre[0] = {{1, 0, 0, 0}};
-    suf[k] = {{1, 0, 0, 0}};
-    for (size_t i = 0; i < k; ++i) pre[i + 1] = mulmod(pre[i], xs[i]);
-    for (size_t i = k; i-- > 0;) suf[i] = mulmod(suf[i + 1], xs[i]);
-    for (size_t i = 0; i < k; ++i) nums[i] = mulmod(pre[i], suf[i + 1]);
+    pre[0] = ONE_MONT;
+    suf[k] = ONE_MONT;
+    for (size_t i = 0; i < k; ++i) pre[i + 1] = mont_mul(pre[i], xs_m[i]);
+    for (size_t i = k; i-- > 0;) suf[i] = mont_mul(suf[i + 1], xs_m[i]);
+    for (size_t i = 0; i < k; ++i) nums_m[i] = mont_mul(pre[i], suf[i + 1]);
   }
-  for (size_t i = 0; i < k; ++i) {
-    U256 den = {{1, 0, 0, 0}};
-    for (size_t j = 0; j < k; ++j) {
-      if (j == i) continue;
-      den = mulmod(den, submod(xs[j], xs[i]));
-    }
-    dens[i] = den;
-  }
-  // batch inversion
-  std::vector<U256> prefix(k + 1);
-  prefix[0] = {{1, 0, 0, 0}};
-  for (size_t i = 0; i < k; ++i) prefix[i + 1] = mulmod(prefix[i], dens[i]);
-  U256 inv_acc = invmod(prefix[k]);
+  // batch inversion (one Fermat ladder for every denominator)
+  std::vector<U256> dens_m(k), prefix(k + 1);
+  for (size_t i = 0; i < k; ++i) dens_m[i] = to_mont(dens[i]);
+  prefix[0] = ONE_MONT;
+  for (size_t i = 0; i < k; ++i) prefix[i + 1] = mont_mul(prefix[i], dens_m[i]);
+  U256 inv_acc;
+  hbf::mont_inv4(prefix[k].w, inv_acc.w);
   for (size_t i = k; i-- > 0;) {
-    U256 d_inv = mulmod(inv_acc, prefix[i]);
-    inv_acc = mulmod(inv_acc, dens[i]);
-    coeffs[i] = mulmod(nums[i], d_inv);
+    U256 d_inv = mont_mul(inv_acc, prefix[i]);
+    inv_acc = mont_mul(inv_acc, dens_m[i]);
+    coeffs[i] = from_mont(mont_mul(nums_m[i], d_inv));
   }
   return coeffs;
 }
@@ -1311,31 +1284,6 @@ inline uint64_t rlc_mix(uint64_t z) {
   return z ^ (z >> 31);
 }
 
-// acc += a * r, acc an 8-word little-endian unreduced integer.
-inline void rlc_acc_mul(uint64_t acc[8], const U256& a, uint64_t r) {
-  unsigned __int128 c = 0;
-  for (int i = 0; i < 4; ++i) {
-    c += (unsigned __int128)a.w[i] * r + acc[i];
-    acc[i] = (uint64_t)c;
-    c >>= 64;
-  }
-  for (int i = 4; i < 8 && c; ++i) {
-    c += acc[i];
-    acc[i] = (uint64_t)c;
-    c >>= 64;
-  }
-}
-
-// 512-bit unreduced value mod r: redc gives T*2^-256 mod r (valid for
-// T < r*2^256, which k*2^319 accumulators satisfy for any feasible k);
-// multiplying by R2 = 2^512 mod r and reducing again restores T mod r.
-inline U256 rlc_reduce512(const uint64_t t[8]) {
-  U256 m = redc(t);
-  uint64_t t2[8];
-  u256_mul_raw(m, R2_MOD, t2);
-  return redc(t2);
-}
-
 // ---- Scalar RLC share verification: one core, two layouts ----------------
 //
 // The RLC math (coefficient chain, unreduced accumulators, bisection,
@@ -1350,15 +1298,32 @@ inline U256 rlc_reduce512(const uint64_t t[8]) {
 
 // Per-instance check constants: TS verifies share == pk*h1 (h1 =
 // doc_h); TD verifies share*h1 == pk*h2 (h1 = ct_h, h2 = ct.w).
+// h1m/h2m are the Montgomery lifts (h*2^256), computed once per
+// instance lookup so every check below is one-REDC (round 15); the
+// products they produce are the EXACT canonical values the classic
+// mulmod forms produced, so verdicts and fault logs are unchanged.
 struct RlcInstance {
   bool is_ts;
   const U256* h1;
   const U256* h2;
+  U256 h1m, h2m;
 };
 
 inline RlcInstance rlc_instance(const Pending& p) {
-  if (p.cont == CONT_TS) return {true, &p.ts->doc_h, nullptr};
-  return {false, &p.td->ct_h, &p.td->ct.w};
+  RlcInstance in;
+  if (p.cont == CONT_TS) {
+    in.is_ts = true;
+    in.h1 = &p.ts->doc_h;
+    in.h2 = nullptr;
+    in.h1m = to_mont(*in.h1);
+  } else {
+    in.is_ts = false;
+    in.h1 = &p.td->ct_h;
+    in.h2 = &p.td->ct.w;
+    in.h1m = to_mont(*in.h1);
+    in.h2m = to_mont(*in.h2);
+  }
+  return in;
 }
 
 inline uint64_t rlc_seed(const RlcInstance& in) {
@@ -1376,19 +1341,27 @@ inline uint64_t rlc_seed(const RlcInstance& in) {
 // flows through mulmod), so non-canonical decrypt shares pass in both
 // paths alike; no extra gate there.
 inline bool rlc_eq(const RlcInstance& in, const U256& sh, const U256& pk) {
+  // mont_mul(x, hm) = x*h*2^256*2^-256 = x*h — the exact canonical
+  // product the classic mulmod produced, in one REDC.
   if (in.is_ts) {
     if (u256_cmp(sh, R_MOD) >= 0) return false;
-    return sh == mulmod(pk, *in.h1);
+    return sh == mont_mul(pk, in.h1m);
   }
-  return mulmod(sh, *in.h1) == mulmod(pk, *in.h2);
+  return mont_mul(sh, in.h1m) == mont_mul(pk, in.h2m);
 }
 
 inline bool rlc_eq_acc(const RlcInstance& in, const uint64_t sh[8],
                        const uint64_t pk[8]) {
-  if (in.is_ts)
-    return rlc_reduce512(sh) == mulmod(rlc_reduce512(pk), *in.h1);
-  return mulmod(rlc_reduce512(sh), *in.h1) ==
-         mulmod(rlc_reduce512(pk), *in.h2);
+  // The 512-bit accumulators reduce through ONE redc each (S*2^-256);
+  // comparing both sides in that uniformly 2^-256-scaled domain is
+  // exact (x -> x*2^-256 is a bijection mod r):
+  //   TS:  S == P*h1      <=>  S*2^-256 == mont_mul(P*2^-256, h1m)
+  //   TD:  S*h1 == P*h2   <=>  mont_mul(S*2^-256, h1m) ==
+  //                            mont_mul(P*2^-256, h2m)
+  // so verdicts are identical to the classic two-REDC-per-side form.
+  U256 s = redc(sh), p = redc(pk);
+  if (in.is_ts) return s == mont_mul(p, in.h1m);
+  return mont_mul(s, in.h1m) == mont_mul(p, in.h2m);
 }
 
 struct GrpView {
@@ -1408,12 +1381,23 @@ struct CsrView {
   void set_ok(size_t k, bool v) { items[idxs[k]].pre_ok = v; }
 };
 
-// One RLC check over v[lo..hi).
+// One RLC check over v[lo..hi).  Two passes (round 15): the sequential
+// coefficient chain (+ the TS canonicity gate) first, then the
+// accumulate as one batched kernel call over gathered contiguous
+// arrays.  The coefficient stream, early-fail behavior, and the exact
+// integer sums are identical to the fused per-item loop it replaces —
+// an integer sum is order- and arm-independent.
 template <class V>
 inline bool rlc_check_range_v(const RlcInstance& in, const V& v, size_t lo,
                               size_t hi, uint64_t seed) {
-  uint64_t acc_sh[8] = {0, 0, 0, 0, 0, 0, 0, 0};
-  uint64_t acc_pk[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  size_t n = hi - lo;
+  // Workers run this under engine_run_mt: scratch is thread-local,
+  // capacity retained across checks (group sizes are small and bursty).
+  thread_local std::vector<uint64_t> coeffs;
+  thread_local std::vector<U256> shs, pks;
+  coeffs.resize(n);
+  shs.resize(n);
+  pks.resize(n);
   uint64_t state = rlc_mix(seed ^ (uint64_t)lo * 0xc2b2ae3d27d4eb4fULL ^
                            (uint64_t)hi * 0x165667b19e3779f9ULL);
   for (size_t k = lo; k < hi; ++k) {
@@ -1424,10 +1408,14 @@ inline bool rlc_check_range_v(const RlcInstance& in, const V& v, size_t lo,
     if (in.is_ts && u256_cmp(v.share(k), R_MOD) >= 0) return false;
     state = rlc_mix(state ^ v.share(k).w[0] ^
                     ((uint64_t)(uint32_t)v.sender(k) << 32));
-    uint64_t r = state | 1;  // nonzero: a lone bad share can never cancel
-    rlc_acc_mul(acc_sh, v.share(k), r);
-    rlc_acc_mul(acc_pk, v.pk(k), r);
+    coeffs[k - lo] = state | 1;  // nonzero: a lone bad share can't cancel
+    shs[k - lo] = v.share(k);
+    pks[k - lo] = v.pk(k);
   }
+  uint64_t acc_sh[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  uint64_t acc_pk[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  hbf::rlc_accum(shs[0].w, coeffs.data(), n, acc_sh);
+  hbf::rlc_accum(pks[0].w, coeffs.data(), n, acc_pk);
   return rlc_eq_acc(in, acc_sh, acc_pk);
 }
 
@@ -1989,11 +1977,27 @@ struct Ctx {
     // NOT apply through the dereference of a temporary, and a
     // concurrent cache eviction dropping the last refcount mid-sum
     // would be a use-after-free under engine_run_mt.
+    uint64_t t0 = prof_tick();
     std::shared_ptr<const std::vector<U256>> lam_p = lagrange_cached(idxs);
     const std::vector<U256>& lam = *lam_p;
-    U256 acc = U256_ZERO;
-    for (size_t i = 0; i < by_index.size(); ++i)
-      acc = addmod(acc, mulmod(lam[i], by_index[i].second));
+    // Gather the shares contiguous and run the whole Lagrange sum as
+    // one batched dot product (field plane; round 15).  thread_local
+    // scratch (the rlc_check_range_v pattern, per-worker under
+    // engine_run_mt): the gather sits inside the slot-14 timed window,
+    // so a per-combine allocation would fold allocator jitter into the
+    // A/B readout.
+    static thread_local std::vector<U256> shs;
+    shs.resize(by_index.size());
+    for (size_t i = 0; i < by_index.size(); ++i) shs[i] = by_index[i].second;
+    U256 acc;
+    hbf::dot_batch(lam[0].w, shs[0].w, shs.size(), acc.w);
+    if (!e.mt_active) {
+      // Slot 14 (registry: SIMD combine-kernel wall): the COIN/DECRYPT
+      // combine component — Lagrange coefficients + combine-sum — for
+      // the HBBFT_TPU_SIMD A/B readout.
+      e.prof_cycles[14] += prof_tick() - t0;
+      e.prof_count[14]++;
+    }
     ts.signature = acc;
     ts.terminated = true;
     parity_out.push_back(sig_parity(acc) ? 1 : 0);
@@ -3121,11 +3125,20 @@ struct Ctx {
     idxs.reserve(by_index.size());
     for (auto& kv : by_index) idxs.push_back(kv.first);
     // shared_ptr held across the sum — see ts_try_output's combine.
+    uint64_t tk0 = prof_tick();
     std::shared_ptr<const std::vector<U256>> lam_p = lagrange_cached(idxs);
     const std::vector<U256>& lam = *lam_p;
-    U256 acc = U256_ZERO;
-    for (size_t i = 0; i < by_index.size(); ++i)
-      acc = addmod(acc, mulmod(lam[i], by_index[i].second));
+    // thread_local scratch inside the timed window — see ts_try_output.
+    static thread_local std::vector<U256> shs;
+    shs.resize(by_index.size());
+    for (size_t i = 0; i < by_index.size(); ++i) shs[i] = by_index[i].second;
+    U256 acc;
+    hbf::dot_batch(lam[0].w, shs[0].w, shs.size(), acc.w);
+    if (!e.mt_active) {
+      // Slot 14 (registry: SIMD combine-kernel wall) — see ts_try_output.
+      e.prof_cycles[14] += prof_tick() - tk0;
+      e.prof_count[14]++;
+    }
     uint8_t acc_be[32];
     u256_to_be32(acc, acc_be);
     Root key;
@@ -3712,17 +3725,18 @@ void engine_flush_pool(Engine& e, Node& node) {
         pending_run_grp(e, node, p);
         if (!e.mt_active) {
           // Slot 11 (registry): groups dispatched; chunk-check cycles
-          // are inside the continuation stamp (slot 14 + typed).
+          // are inside the typed continuation stamps below.
           e.prof_count[11]++;
           e.prof_cycles[11] += prof_tick() - t0;
         }
       } else {
         pending_run(e, node, p, p.pre_ok);
       }
+      // (The round-4 slot-14 pool-flush total was retired in round 15 —
+      // the slot now stamps the combine kernel at ts/td_try_output; the
+      // typed fold below still carries the continuation wall.)
       if (!e.mt_active) {  // profiling counters are single-writer only
         uint64_t dt = prof_tick() - t0;
-        e.prof_cycles[14] += dt;
-        e.prof_count[14]++;
         if (e.in_deferred_flush) {
           // Deferred flushes run outside engine_run's typed delivery
           // stamp: fold the verification + continuation cycles back
@@ -4809,12 +4823,16 @@ DkgRegistry& dkg_registry() {
 const std::vector<U256>& dkg_row(DkgCommit& c, int x) {
   auto it = c.rows.find(x);
   if (it != c.rows.end()) return it->second;
+  // One-side-Montgomery Horner (round 15): x lifts once, each step is
+  // one REDC producing the exact canonical acc*x the classic mulmod
+  // produced — identical rows, half the reduction work.
   U256 xs = {{(uint64_t)x, 0, 0, 0}};
+  U256 xm = to_mont(xs);
   std::vector<U256> out(c.n1);
   for (int j = 0; j < c.n1; ++j) {
     U256 acc = U256_ZERO;
     for (int i = c.n1 - 1; i >= 0; --i)
-      acc = addmod(mulmod(acc, xs), c.elems[i * c.n1 + j]);
+      acc = addmod(mont_mul(acc, xm), c.elems[i * c.n1 + j]);
     out[j] = acc;
   }
   return c.rows.emplace(x, std::move(out)).first->second;
@@ -4857,9 +4875,10 @@ inline DkgRowCopy dkg_copy_row(DkgRegistry& reg, int64_t cid, int x) {
 // expected value); runs lock-free over a DkgRowCopy.
 inline U256 dkg_row_eval(const DkgRowCopy& rc, int y) {
   U256 ys = {{(uint64_t)y, 0, 0, 0}};
+  U256 ym = to_mont(ys);  // one-side-Montgomery Horner, see dkg_row
   U256 acc = U256_ZERO;
   for (int j = rc.n1 - 1; j >= 0; --j)
-    acc = addmod(mulmod(acc, ys), rc.row[j]);
+    acc = addmod(mont_mul(acc, ym), rc.row[j]);
   return acc;
 }
 
@@ -5061,10 +5080,16 @@ int32_t hbe_dkg_ack_check_batch(const int64_t* cids,
     }
   }
   // our_pos is fixed across the batch, so each distinct row's expected
-  // value is one Horner — not one per referencing ack.
+  // value is one Horner — not one per referencing ack (and each row's
+  // generator lifts to the Montgomery domain once for the per-ack
+  // g*val products below).
   std::vector<U256> expected(uniq.size(), U256_ZERO);
+  std::vector<U256> gms(uniq.size(), U256_ZERO);
   for (size_t k = 0; k < uniq.size(); ++k)
-    if (uniq[k].ok) expected[k] = dkg_row_eval(uniq[k], our_pos);
+    if (uniq[k].ok) {
+      expected[k] = dkg_row_eval(uniq[k], our_pos);
+      gms[k] = to_mont(uniq[k].g);
+    }
   for (int32_t i = 0; i < count; ++i) {
     const DkgRowCopy& rc = uniq[ref[i]];
     if (!rc.ok) {
@@ -5082,7 +5107,7 @@ int32_t hbe_dkg_ack_check_batch(const int64_t* cids,
       rc_out[i] = 2;
       continue;
     }
-    if (!(mulmod(rc.g, val) == expected[ref[i]])) {
+    if (!(mont_mul(val, gms[ref[i]]) == expected[ref[i]])) {
       rc_out[i] = 2;
       continue;
     }
@@ -5107,10 +5132,11 @@ int32_t hbe_dkg_row_check(int64_t cid, int32_t our_pos, const uint8_t* plain,
   }
   if (!rc.ok) return -1;
   if (n_coeffs != rc.n1) return 0;
+  U256 gm = to_mont(rc.g);  // g is loop-invariant: lift once
   for (int j = 0; j < rc.n1; ++j) {
     U256 v = u256_from_be(plain + 32 * j, 32);
     if (!(u256_cmp(v, R_MOD) < 0)) return 0;
-    if (!(mulmod(rc.g, v) == rc.row[j])) return 0;
+    if (!(mont_mul(v, gm) == rc.row[j])) return 0;
   }
   return 1;
 }
@@ -5169,10 +5195,11 @@ int32_t hbe_dkg_part_check_batch(const int64_t* cids, int32_t count,
       rc_out[i] = 2;    // per-item row_check's n_coeffs != n1 verdict
       continue;
     }
+    U256 gm = to_mont(rc.g);  // lift once per part, see hbe_dkg_row_check
     int ok = 1;
     for (int j = 0; j < n1 && ok; ++j) {
       U256 v = u256_from_be(plain + 32 * (size_t)j, 32);
-      if (!(u256_cmp(v, R_MOD) < 0) || !(mulmod(rc.g, v) == rc.row[j]))
+      if (!(u256_cmp(v, R_MOD) < 0) || !(mont_mul(v, gm) == rc.row[j]))
         ok = 0;
     }
     rc_out[i] = ok ? 1 : 2;
@@ -5388,52 +5415,70 @@ int32_t hbe_scalar_interp_sum(const int32_t* xs, const uint8_t* ys_be,
                               const uint8_t* r_be, uint8_t* out32) {
   if (n_groups < 1 || n_groups > (1 << 20)) return 0;
   if (!(u256_from_be(r_be, 32) == R_MOD)) return 0;
-  const U256 one = {{1, 0, 0, 0}};
   size_t total = 0;
   for (int32_t g = 0; g < n_groups; ++g) {
     if (counts[g] < 1 || counts[g] > 65536) return 0;
     total += (size_t)counts[g];
   }
-  // Pass 1: per-point Lagrange numerator/denominator products.
-  std::vector<U256> nums(total), dens(total), ys(total);
+  // Pass 1 (round 15): per-group numerators via prefix/suffix products
+  // in the Montgomery domain (O(cnt) one-REDC muls) and denominators
+  // through the dispatched batch kernel (field_plane.h) — exactly the
+  // same products mod r the old O(cnt^2) mulmod loops computed, so the
+  // sum stays byte-identical to poly.interpolate in both SIMD arms.
+  std::vector<U256> nums_m(total), dens(total), ys(total);
   {
     const int32_t* gx = xs;
     const uint8_t* gy = ys_be;
     size_t base = 0;
+    std::vector<int64_t> xs64;
+    std::vector<U256> xs_m, pre, suf;
     for (int32_t g = 0; g < n_groups; ++g) {
       int32_t cnt = counts[g];
+      xs64.resize(cnt);
       for (int32_t k = 0; k < cnt; ++k) {
         if (gx[k] <= 0) return 0;
         ys[base + k] = u256_from_be(gy + 32 * (size_t)k, 32);
         if (!(u256_cmp(ys[base + k], R_MOD) < 0)) return 0;
-        U256 num = one, den = one;
-        U256 xk = {{(uint64_t)gx[k], 0, 0, 0}};
-        for (int32_t j = 0; j < cnt; ++j) {
-          if (j == k) continue;
-          U256 xj = {{(uint64_t)gx[j], 0, 0, 0}};
-          num = mulmod(num, xj);
-          den = mulmod(den, submod(xj, xk));
-        }
-        if (u256_is_zero(den)) return 0;  // duplicate x: fall back
-        nums[base + k] = num;
-        dens[base + k] = den;
+        xs64[k] = gx[k];
       }
+      hbf::lagrange_dens(xs64.data(), cnt, dens[base].w);
+      for (int32_t k = 0; k < cnt; ++k)
+        if (u256_is_zero(dens[base + k])) return 0;  // duplicate x
+      xs_m.resize(cnt);
+      for (int32_t k = 0; k < cnt; ++k) {
+        U256 x = {{(uint64_t)xs64[k], 0, 0, 0}};
+        xs_m[k] = to_mont(x);
+      }
+      pre.assign(cnt + 1, ONE_MONT);
+      suf.assign(cnt + 1, ONE_MONT);
+      for (int32_t k = 0; k < cnt; ++k)
+        pre[k + 1] = mont_mul(pre[k], xs_m[k]);
+      for (int32_t k = cnt; k-- > 0;) suf[k] = mont_mul(suf[k + 1], xs_m[k]);
+      for (int32_t k = 0; k < cnt; ++k)
+        nums_m[base + k] = mont_mul(pre[k], suf[k + 1]);
       gx += cnt;
       gy += (size_t)cnt * 32;
       base += (size_t)cnt;
     }
   }
-  // Pass 2: one shared inversion, then accumulate y*num*den^-1.
+  // Pass 2: one shared inversion, then accumulate y*num*den^-1 — the
+  // chain runs in the Montgomery domain; each term converts back
+  // through its final one-REDC products (exact canonical values).
   std::vector<U256> prefix(total + 1);
-  prefix[0] = one;
+  prefix[0] = ONE_MONT;
+  std::vector<U256> dens_m(total);
+  for (size_t i = 0; i < total; ++i) dens_m[i] = to_mont(dens[i]);
   for (size_t i = 0; i < total; ++i)
-    prefix[i + 1] = mulmod(prefix[i], dens[i]);
-  U256 inv_acc = invmod(prefix[total]);
+    prefix[i + 1] = mont_mul(prefix[i], dens_m[i]);
+  U256 inv_acc;
+  hbf::mont_inv4(prefix[total].w, inv_acc.w);
   U256 acc = U256_ZERO;
   for (size_t i = total; i-- > 0;) {
-    U256 dinv = mulmod(inv_acc, prefix[i]);
-    inv_acc = mulmod(inv_acc, dens[i]);
-    acc = addmod(acc, mulmod(mulmod(ys[i], nums[i]), dinv));
+    U256 dinv_m = mont_mul(inv_acc, prefix[i]);
+    inv_acc = mont_mul(inv_acc, dens_m[i]);
+    // mont_mul(ys, nums_m) = ys*num (canonical); then *dinv likewise.
+    U256 t = mont_mul(ys[i], nums_m[i]);
+    acc = addmod(acc, mont_mul(t, dinv_m));
   }
   u256_to_be32(acc, out32);
   return 1;
@@ -5469,9 +5514,10 @@ void hbe_dkg_row_evals(const uint8_t* coeffs_be, int32_t n_coeffs,
     cs[k] = u256_from_be(coeffs_be + 32 * k, 32);
   for (int32_t p = 0; p < n_points; ++p) {
     U256 x = {{(uint64_t)(p + 1), 0, 0, 0}};
+    U256 xm = to_mont(x);  // one-side-Montgomery Horner, see dkg_row
     U256 acc = U256_ZERO;
     for (int32_t k = n_coeffs - 1; k >= 0; --k)
-      acc = addmod(mulmod(acc, x), cs[k]);
+      acc = addmod(mont_mul(acc, xm), cs[k]);
     u256_to_be32(acc, out + 32 * p);
   }
 }
@@ -5480,6 +5526,85 @@ void hbe_dkg_row_evals(const uint8_t* coeffs_be, int32_t n_coeffs,
 // loader verifies a pre-built library is wide enough for the requested
 // network instead of letting hbe_create fail opaquely).
 int32_t hbe_words() { return HBE_WORDS; }
+
+// --- SIMD field-plane dispatch + kernel test surface (round 15) ------------
+//
+// The vectorized field-arithmetic plane (native/field_plane.h /
+// native/field_ifma.cpp) dispatches per call: AVX-512 IFMA when the
+// build compiled it AND the host advertises it AND HBBFT_TPU_SIMD is
+// not "0".  hbe_simd_force flips arms in-process (-1 = back to auto) so
+// the equivalence/fuzz tests can pin both arms in one interpreter; the
+// setting is process-global and read with relaxed atomics (flip only
+// between runs).
+
+int32_t hbe_simd_compiled() { return hbf_ifma_compiled(); }
+int32_t hbe_simd_mode() { return hbf::simd_mode(); }
+int32_t hbe_simd_force(int32_t mode) { return hbf::simd_force(mode); }
+
+// Elementwise batched a*b mod r over 32-byte BE scalars (fuzz surface
+// for the dispatched kernel; at least one side of each pair < r).
+void hbe_field_mul_batch(const uint8_t* a_be, const uint8_t* b_be, int32_t n,
+                         uint8_t* out_be) {
+  if (n <= 0) return;
+  std::vector<U256> a(n), b(n), out(n);
+  for (int32_t i = 0; i < n; ++i) {
+    a[i] = u256_from_be(a_be + 32 * i, 32);
+    b[i] = u256_from_be(b_be + 32 * i, 32);
+  }
+  hbf::mul_batch(a[0].w, b[0].w, out[0].w, (size_t)n);
+  for (int32_t i = 0; i < n; ++i) u256_to_be32(out[i], out_be + 32 * i);
+}
+
+// sum_i a_i*b_i mod r (the combine-sum kernel's fuzz surface).
+void hbe_field_dot(const uint8_t* a_be, const uint8_t* b_be, int32_t n,
+                   uint8_t* out32) {
+  if (n <= 0) {
+    std::memset(out32, 0, 32);
+    return;
+  }
+  std::vector<U256> a(n), b(n);
+  for (int32_t i = 0; i < n; ++i) {
+    a[i] = u256_from_be(a_be + 32 * i, 32);
+    b[i] = u256_from_be(b_be + 32 * i, 32);
+  }
+  U256 acc;
+  hbf::dot_batch(a[0].w, b[0].w, (size_t)n, acc.w);
+  u256_to_be32(acc, out32);
+}
+
+// Lagrange coefficients at 0 for x_i = idxs[i]+1 (exactly the engine's
+// combine-path lagrange(); oracle-checked against crypto/poly.py).
+void hbe_field_lagrange(const int32_t* idxs, int32_t k, uint8_t* out_be) {
+  if (k <= 0) return;
+  std::vector<int> v(idxs, idxs + k);
+  std::vector<U256> coeffs = lagrange(v);
+  for (int32_t i = 0; i < k; ++i) u256_to_be32(coeffs[i], out_be + 32 * i);
+}
+
+// acc64 (64-byte BE) = sum_i coeffs[i]*x[i] as an exact integer (the
+// RLC accumulate kernel's fuzz surface; coeffs are 8-byte BE).
+void hbe_field_rlc_accum(const uint8_t* x_be, const uint8_t* coeffs_be,
+                         int32_t n, uint8_t* acc64_be) {
+  if (n <= 0) {
+    std::memset(acc64_be, 0, 64);
+    return;
+  }
+  std::vector<U256> x(n);
+  std::vector<uint64_t> cs(n);
+  for (int32_t i = 0; i < n; ++i) {
+    x[i] = u256_from_be(x_be + 32 * i, 32);
+    uint64_t c = 0;
+    for (int j = 0; j < 8; ++j) c = (c << 8) | coeffs_be[8 * i + j];
+    cs[i] = c;
+  }
+  uint64_t acc[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+  hbf::rlc_accum(x[0].w, cs.data(), (size_t)n, acc);
+  for (int i = 0; i < 8; ++i) {
+    uint64_t w = acc[7 - i];
+    for (int j = 0; j < 8; ++j)
+      acc64_be[8 * i + j] = (uint8_t)(w >> (56 - 8 * j));
+  }
+}
 
 void* hbe_create(int32_t n, int32_t f) {
   // MAX_NODES = this build's NodeSet width (the loader picks a wide

@@ -1,0 +1,281 @@
+"""Vectorized field-arithmetic plane (ISSUE 14): both dispatch arms of
+the batched Montgomery kernels against the 4x64 oracle and the
+pure-Python crypto path.
+
+Structure:
+
+* **Kernel fuzz** — random vectors (canonical AND non-canonical/
+  congruent values at the boundaries, odd tail lengths) through
+  ``hbe_field_*`` in BOTH arms (``hbe_simd_force``), checked against
+  plain Python big-int arithmetic mod r — the same oracle discipline as
+  the TPU crypto tests (pure-Python is the source of truth).
+* **Oracle cross-check** — a scalar-suite threshold-signature combine
+  and a DKG-style interpolation through ``hbe_scalar_interp_sum`` in
+  both arms vs ``crypto/poly.py`` (the pure-Python path the engine
+  mirrors).
+* **Protocol identity** — a full NativeQhbNet epoch byte-identical
+  across forced arms (the dispatch-identity contract,
+  docs/INVARIANTS.md; the full equivalence suites pin the same thing
+  against the Python net via the HBBFT_TPU_SIMD env arms).
+* **Wide-NodeSet smoke** — an era change on a forced ``-DHBE_WORDS=8``
+  build at small N, byte-identical to the default-width build (the
+  post-256-node-cap path of ROADMAP item 4; scale runs past N=256 pick
+  the wide build automatically).
+
+On hosts without AVX-512 IFMA the force-1 arm resolves to scalar and
+the cross-arm tests degenerate to scalar-vs-scalar (still valid, just
+not discriminating) — the kernels' scalar arm stays covered everywhere.
+"""
+
+import ctypes
+import random
+
+import pytest
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.crypto import poly
+from hbbft_tpu.crypto.suite import ScalarSuite
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.available(), reason="native engine unavailable"
+)
+
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+
+@pytest.fixture
+def lib():
+    lib = native_engine.get_lib()
+    yield lib
+    lib.hbe_simd_force(-1)  # back to HBBFT_TPU_SIMD/auto
+
+
+def _be(x: int, n: int = 32) -> bytes:
+    return int(x).to_bytes(n, "big")
+
+
+def _arms(lib):
+    """Force each dispatch arm in turn; the forced mode must resolve
+    exactly (force-1 clamps to scalar only on non-IFMA hosts)."""
+    for want in (0, 1):
+        got = int(lib.hbe_simd_force(want))
+        if want == 1 and not lib.hbe_simd_compiled():
+            assert got == 0
+        yield want, got
+
+
+def test_simd_mode_reporting(lib):
+    assert int(lib.hbe_simd_compiled()) in (0, 1)
+    assert int(lib.hbe_simd_mode()) in (0, 1)
+    assert int(lib.hbe_simd_force(0)) == 0
+    assert int(lib.hbe_simd_force(-1)) == int(lib.hbe_simd_mode())
+
+
+def test_mul_batch_fuzz_both_arms(lib):
+    rng = random.Random(1401)
+    for mode, _ in _arms(lib):
+        for _ in range(25):
+            n = rng.choice([1, 2, 3, 7, 8, 9, 15, 16, 17, 40, 101])
+            a = [rng.randrange(R) for _ in range(n)]
+            b = []
+            for _ in range(n):
+                v = rng.randrange(R)
+                # non-canonical congruent encodings on ONE side (the
+                # engine's precondition: at least one side canonical)
+                if rng.random() < 0.4 and v + R < 1 << 256:
+                    v += R
+                b.append(v)
+            if n >= 2:  # boundary values
+                a[0], b[0] = R - 1, R - 1
+                # max 256-bit non-canonical operand against canonical 0
+                # (the top-limb carry edge of load8/mont_mul8)
+                a[1], b[1] = 0, (1 << 256) - 1
+            if n >= 3:
+                a[2], b[2] = 1, 2 * R - 2
+            out = (ctypes.c_uint8 * (32 * n))()
+            lib.hbe_field_mul_batch(
+                b"".join(_be(x) for x in a), b"".join(_be(x) for x in b), n, out
+            )
+            got = [
+                int.from_bytes(bytes(out[32 * i : 32 * i + 32]), "big")
+                for i in range(n)
+            ]
+            assert got == [(x * y) % R for x, y in zip(a, b)], mode
+
+
+def test_dot_and_rlc_accum_fuzz_both_arms(lib):
+    rng = random.Random(1402)
+    for mode, _ in _arms(lib):
+        for _ in range(25):
+            n = rng.choice([1, 3, 8, 9, 31, 32, 33, 64, 101])
+            a = [rng.randrange(R) for _ in range(n)]
+            b = [rng.randrange(R) for _ in range(n)]
+            o32 = (ctypes.c_uint8 * 32)()
+            lib.hbe_field_dot(
+                b"".join(_be(x) for x in a), b"".join(_be(x) for x in b), n, o32
+            )
+            assert (
+                int.from_bytes(bytes(o32), "big")
+                == sum(x * y for x, y in zip(a, b)) % R
+            ), mode
+            # RLC accumulate is an EXACT integer (not a residue): shares
+            # may be non-canonical wire values
+            x = [
+                v + R if rng.random() < 0.3 and v + R < 1 << 256 else v
+                for v in a
+            ]
+            cs = [rng.randrange(1, 1 << 64) for _ in range(n)]
+            o64 = (ctypes.c_uint8 * 64)()
+            lib.hbe_field_rlc_accum(
+                b"".join(_be(v) for v in x),
+                b"".join(_be(c, 8) for c in cs),
+                n,
+                o64,
+            )
+            assert int.from_bytes(bytes(o64), "big") == sum(
+                c * v for c, v in zip(cs, x)
+            ), mode
+
+
+def test_lagrange_coefficients_vs_python_oracle(lib):
+    rng = random.Random(1403)
+    for mode, _ in _arms(lib):
+        for k in (1, 2, 3, 7, 8, 9, 33, 101):
+            idxs = rng.sample(range(300), k)
+            out = (ctypes.c_uint8 * (32 * k))()
+            lib.hbe_field_lagrange((ctypes.c_int32 * k)(*idxs), k, out)
+            oracle = poly.lagrange_coefficients(idxs, R)
+            for i, idx in enumerate(idxs):
+                got = int.from_bytes(bytes(out[32 * i : 32 * i + 32]), "big")
+                assert got == oracle[idx], (mode, k, idx)
+
+
+def test_interp_and_combine_vs_python_oracle(lib):
+    """A scalar-suite threshold combine through hbe_scalar_interp_sum in
+    both arms vs the pure-Python crypto path (poly.interpolate and a
+    hand combine over real suite shares)."""
+    suite = ScalarSuite()
+    rng = random.Random(1404)
+    from hbbft_tpu.crypto.keys import SecretKeySet
+
+    sks = SecretKeySet.random(3, rng, suite)
+    pks = sks.public_keys()
+    msg = b"simd-combine-oracle"
+    shares = {i: sks.secret_key_share(i).sign(msg) for i in range(7)}
+    # pure-Python expected signature value: Lagrange over the share
+    # scalars (ScalarSuite group elements are ints)
+    idxs = [0, 2, 3, 5]
+    lam = poly.lagrange_coefficients(idxs, R)
+    expected = (
+        sum(lam[i] * shares[i].g2.value for i in idxs) % R
+    )
+    r_be = _be(R)
+    for mode, _ in _arms(lib):
+        xs = (ctypes.c_int32 * len(idxs))(*[i + 1 for i in idxs])
+        ys = b"".join(_be(shares[i].g2.value) for i in idxs)
+        counts = (ctypes.c_int32 * 1)(len(idxs))
+        out = (ctypes.c_uint8 * 32)()
+        ok = int(lib.hbe_scalar_interp_sum(xs, ys, counts, 1, r_be, out))
+        assert ok == 1
+        assert int.from_bytes(bytes(out), "big") == expected, mode
+        # grouped interpolation (the SyncKeyGen.generate shape): the sum
+        # of per-group interpolations matches poly.interpolate
+        pts = [[(x, rng.randrange(R)) for x in (1, 2, 3, 4)] for _ in range(3)]
+        exp_sum = sum(poly.interpolate(g, R) for g in pts) % R
+        gxs = (ctypes.c_int32 * 12)(*[x for g in pts for (x, _) in g])
+        gys = b"".join(_be(y) for g in pts for (_, y) in g)
+        gcounts = (ctypes.c_int32 * 3)(4, 4, 4)
+        out2 = (ctypes.c_uint8 * 32)()
+        ok = int(lib.hbe_scalar_interp_sum(gxs, gys, gcounts, 3, r_be, out2))
+        assert ok == 1
+        assert int.from_bytes(bytes(out2), "big") == exp_sum, mode
+    # end-to-end: the keys.py combine (which routes through the same
+    # native kernel when available) agrees with the oracle value
+    sig = pks.combine_signatures({i: shares[i] for i in idxs})
+    assert sig.g2.value == expected
+
+
+def test_epoch_byte_identical_across_arms(lib):
+    """The dispatch-identity contract at the protocol level: one
+    NativeQhbNet epoch per forced arm, identical batches and faults."""
+    results = []
+    for mode, got in _arms(lib):
+        nat = native_engine.NativeQhbNet(4, seed=9, batch_size=3,
+                                         session_id=b"simd-arms")
+        for i in nat.correct_ids:
+            nat.send_input(i, ("tx", i))
+        nat.run_until(
+            lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+            chunk=1,
+        )
+        results.append(
+            (
+                got,
+                [
+                    [
+                        (b.era, b.epoch, b.contributions)
+                        for b in nat.nodes[i].outputs[:1]
+                    ]
+                    for i in nat.correct_ids
+                ],
+                sorted(
+                    (i, f) for i in nat.correct_ids for f in nat.faults(i)
+                ),
+            )
+        )
+        nat.close()
+    assert results[0][1:] == results[1][1:]
+
+
+def test_w8_era_change_smoke():
+    """The post-cap wide-NodeSet path (ROADMAP item 4): a full era
+    change on a forced -DHBE_WORDS=8 build, byte-identical to the
+    default-width build at the same seed.  N stays small — the width
+    must be inert; N>256 scale runs pick wide builds automatically."""
+    from hbbft_tpu.protocols.dynamic_honey_badger import Change
+    from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+    if native_engine.get_lib(8) is None:
+        pytest.skip("w8 engine build unavailable")
+
+    def run(words):
+        nat = native_engine.NativeQhbNet(
+            4, seed=5, batch_size=3, session_id=b"w8-era",
+            engine_words=words,
+        )
+        assert nat.lib.hbe_words() >= (words or 4)
+        keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+        keep.pop(3)
+        for i in nat.correct_ids:
+            nat.send_input(i, Input.change(Change.node_change(keep)))
+
+        def era_done(e):
+            return all(
+                any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+                for i in e.correct_ids
+            )
+
+        rounds = 1
+        while not era_done(nat) and rounds < 12:
+            for i in nat.correct_ids:
+                nat.send_input(i, Input.user(("era-tx", rounds, i)))
+            rounds += 1
+            nat.run_until(
+                lambda e, w=rounds: all(
+                    len(e.nodes[i].outputs) >= w for i in e.correct_ids
+                ),
+                chunk=1,
+            )
+        assert era_done(nat), "era change did not complete"
+        out = [
+            [
+                (b.era, b.epoch, b.change.kind, b.contributions)
+                for b in nat.nodes[i].outputs
+            ]
+            for i in nat.correct_ids
+        ]
+        faults = sorted((i, f) for i in nat.correct_ids for f in nat.faults(i))
+        nat.close()
+        return out, faults
+
+    assert run(8) == run(None)

@@ -213,6 +213,77 @@ node3.ingest([1, 2, 3, 1, 2], mixed)
 node3.run()
 assert node3.stats()["handled"] >= 2, node3.stats()
 print("SANITIZED-CHAOS-OK")
+
+# Round 15: the vectorized field plane under the sanitizer, BOTH
+# dispatch arms forced in-process (hbe_simd_force).  The kernel fuzz
+# drives the AoS<->SoA conversion/normalization edges (odd tails,
+# non-canonical congruent inputs, near-r values) where an OOB or
+# carry bug hides; the epoch re-run pins cross-arm protocol identity
+# under instrumentation.  On a non-IFMA host force(1) resolves to the
+# scalar arm and this degenerates to scalar-vs-scalar (still a valid
+# sanitizer pass of the batch plane).
+import random as _frng
+
+flib = nat.lib
+mod_r = (0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001)
+rng15 = _frng.Random(15)
+# fixed index set for the cross-arm Lagrange comparison (the fuzz rng
+# advances differently per arm; cross-arm identity needs equal inputs)
+lag_idxs = _frng.Random(99).sample(range(200), 33)
+arm_results = []
+for arm in (0, 1):
+    got = int(flib.hbe_simd_force(arm))
+    for trial in range(6):
+        n = rng15.choice([1, 3, 7, 8, 9, 17, 40])
+        a = [rng15.randrange(mod_r) for _ in range(n)]
+        b = [
+            v + mod_r
+            if rng15.random() < 0.4 and v + mod_r < (1 << 256)
+            else v
+            for v in (rng15.randrange(mod_r) for _ in range(n))
+        ]
+        ab = b"".join(x.to_bytes(32, "big") for x in a)
+        bb = b"".join(x.to_bytes(32, "big") for x in b)
+        out = (ctypes.c_uint8 * (32 * n))()
+        flib.hbe_field_mul_batch(ab, bb, n, out)
+        got_v = [
+            int.from_bytes(bytes(out[32 * i : 32 * i + 32]), "big")
+            for i in range(n)
+        ]
+        assert got_v == [(x * y) % mod_r for x, y in zip(a, b)], (arm, trial)
+        o32 = (ctypes.c_uint8 * 32)()
+        flib.hbe_field_dot(ab, bb, n, o32)
+        assert int.from_bytes(bytes(o32), "big") == (
+            sum(x * y for x, y in zip(a, b)) % mod_r
+        ), (arm, trial)
+    k = 33
+    outl = (ctypes.c_uint8 * (32 * k))()
+    flib.hbe_field_lagrange((ctypes.c_int32 * k)(*lag_idxs), k, outl)
+    nat15 = native_engine.NativeQhbNet(
+        4, seed=3, batch_size=3, session_id=b"sanitizer-simd", **kw
+    )
+    for i in nat15.correct_ids:
+        nat15.send_input(i, ("simd-tx", i))
+    nat15.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+        chunk=1 if threads == 0 else 256,
+    )
+    arm_results.append(
+        (
+            bytes(outl),
+            [
+                [
+                    (b.era, b.epoch, b.contributions)
+                    for b in nat15.nodes[i].outputs[:1]
+                ]
+                for i in nat15.correct_ids
+            ],
+        )
+    )
+    nat15.close()
+flib.hbe_simd_force(-1)
+assert arm_results[0] == arm_results[1], "SIMD arms diverged"
+print("SANITIZED-SIMD-OK")
 """
 
 
@@ -275,6 +346,7 @@ def test_asan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "AddressSanitizer" not in res.stderr
 
@@ -286,6 +358,7 @@ def test_ubsan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "runtime error" not in res.stderr
 
@@ -303,4 +376,5 @@ def test_tsan_multithread_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-SIMD-OK" in res.stdout
     assert "WARNING: ThreadSanitizer" not in res.stderr

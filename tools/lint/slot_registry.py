@@ -19,6 +19,11 @@ re-claimed them for the RLC work, since no slot was free
 the epoch-advance wall — which IS what the old tail heuristic was
 measuring, now stamped exactly and borrowed out of the typed
 per-message slots so COIN/DECRYPT cyc/delivery means share work.
+Round 15 retired the round-4 slot-14 pool-flush total (its diagnosis
+was SETTLED in round 4 and the deferred-flush folding into the typed
+COIN/DECRYPT slots carries the continuation wall since round 7) and
+re-claimed 14 for the SIMD field plane's combine-kernel stats — the
+COIN/DECRYPT combine component the HBBFT_TPU_SIMD A/B adjudicates.
 """
 
 # Dynamic range: prof_cycles[ty] / prof_count[ty], ty = MsgType 0..10.
@@ -32,7 +37,9 @@ CLAIMED_SLOTS = {
     12: "Python batch_cb wall cycles (commit_events, round 6 batch-digest A/B)",
     13: "epoch-advance wall (hb_reset_state recycle + coin setup; "
         "borrowed out of typed slots, round 7)",
-    14: "pool-flush continuation total (engine_flush_pool, round 4)",
+    14: "SIMD combine-kernel wall (cycles = Lagrange coefficients + "
+        "batched combine-sum at ts/td_try_output, count = scalar-mode "
+        "combines; the HBBFT_TPU_SIMD A/B component readout, round 15)",
     15: "Python contrib_cb wall cycles (hb_accept_plaintext decode split, round 6)",
 }
 
