@@ -53,12 +53,21 @@ chaos-smoke:
 
 # Flight-recorder tier (ISSUE 9): trace rings on both node arms, Chrome
 # trace export + phase spans, Prometheus exposition grammar, live
-# /metrics /trace.json /healthz scrape against a driven cluster.  No
+# /metrics /trace.json /healthz scrape against a driven cluster, plus
+# the round-16 critical-path analyzer + /diag stall diagnostician
+# (golden sim-net fixtures, live stall drill, CLI round trip).  No
 # jax/XLA involvement — safe during crypto-cache cold states; the
 # native-arm halves skip cleanly without g++.
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py \
-		tests/test_metrics.py -q -m 'not slow'
+		tests/test_analyze.py tests/test_metrics.py -q -m 'not slow'
+
+# Live stall-diagnostician demo: drive an N-node cluster (default 4)
+# with scrape endpoints up, print its per-epoch critical paths, then
+# partition an honest node and print the /diag verdict.
+N ?= 4
+diag:
+	env JAX_PLATFORMS=cpu PYTHONPATH= $(PYTHON) tools/analyze.py --demo $(N)
 
 # Crypto-plane tier (ISSUE 12): the shared batched share-verification
 # service — service-arm vs inline-arm output identity on both node
@@ -71,4 +80,4 @@ cryptoplane-smoke:
 		-q -m 'not slow'
 
 .PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
-	chaos-smoke obs-smoke cryptoplane-smoke
+	chaos-smoke obs-smoke cryptoplane-smoke diag

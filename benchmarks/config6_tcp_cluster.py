@@ -51,6 +51,13 @@ dumps its trace file at exit and the parent merges them on the shared
 wall clock.  The vectored-egress A/B for any arm is
 ``HBBFT_TPU_SENDMSG=0`` (buffered round-9 path) vs unset (sendmsg
 gather egress) on the same build; every line records the live setting.
+
+Round 16: every line carries the analyzer's ``critical_path`` summary
+(per-epoch critical path to commit, straggler attribution, phase share
+of wall, cross-node skew, BA rounds — docs/OBSERVABILITY.md "Critical
+path & diagnosis") and ``trace_dropped`` (ring-overflow honesty).  The
+proc arm derives its ``critical_path`` from the parent-side trace
+merge, so it needs BENCH_TRACE set.
 """
 
 from __future__ import annotations
@@ -125,7 +132,14 @@ def obs_extras(rec: dict, cluster, name: str, m=None) -> None:
     endpoints (started by the caller right after cluster.start()).
     Pass the caller's merged-metrics snapshot via ``m`` so the JSON
     line's fields all come from ONE instant (and the merge+ring walk
-    runs once per line)."""
+    runs once per line).
+
+    Round 16: every line also carries ``critical_path`` (the analyzer's
+    per-run summary — straggler histograms, phase share of wall, skew,
+    BA rounds, crypto-plane flush totals) and ``trace_dropped`` (total
+    ring-overflow count; nonzero means the trace-derived numbers on
+    this line are silently partial), with the per-node split when any
+    ring actually dropped."""
     if m is None:
         m = cluster.merged_metrics(fresh=True)
     cyc = {
@@ -139,6 +153,19 @@ def obs_extras(rec: dict, cluster, name: str, m=None) -> None:
     if sm is not None:
         rec["epoch_lat_p50_s"] = round(sm.quantiles.get(0.5, 0.0), 4)
         rec["epoch_lat_p99_s"] = round(sm.quantiles.get(0.99, 0.0), 4)
+    from hbbft_tpu.obs.analyze import critical_path, summarize_critical_paths
+
+    rec["critical_path"] = summarize_critical_paths(
+        critical_path(cluster.trace_events())
+    )
+    rec["trace_dropped"] = int(m.gauges.get("trace.dropped", 0))
+    if rec["trace_dropped"]:
+        rec["trace_dropped_by_node"] = {
+            k.split(".")[1]: int(v)
+            for k, v in sorted(m.gauges.items())
+            if k.startswith("trace.") and k.endswith(".dropped")
+            and k != "trace.dropped"
+        }
     trace_dir = os.environ.get("BENCH_TRACE")
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
@@ -209,6 +236,12 @@ def run_n_proc(
                 ),
                 "handler_errors": sum(s["handler_errors"] for s in live),
                 "protocol_faults": sum(s["faults"] for s in live),
+                # ring-overflow honesty (round 16): summed from the
+                # worker summaries — nonzero means the workers' trace
+                # dumps (and the critical_path below) are partial
+                "trace_dropped": sum(
+                    s.get("trace_dropped", 0) for s in live
+                ),
                 "complete": all(
                     s is not None and s["done"] for s in sums.values()
                 ),
@@ -222,6 +255,18 @@ def run_n_proc(
         with open(path, "w") as fh:
             json.dump(merged, fh)
         rec["trace_file"] = path
+        # critical_path over the parent-side merge: the same analyzer
+        # the thread arms run over live rings (tools/analyze.py reads
+        # the dumped file identically).
+        from hbbft_tpu.obs.analyze import (
+            critical_path,
+            summarize_critical_paths,
+            tracks_from_chrome,
+        )
+
+        rec["critical_path"] = summarize_critical_paths(
+            critical_path(tracks_from_chrome(merged))
+        )
     return rec
 
 

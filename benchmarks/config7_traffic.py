@@ -52,6 +52,12 @@ BENCH_TRAFFIC_WAN_SCALE (multiplies the profile's time constants,
 default 1.0), BENCH_TRAFFIC_SEED (default 0),
 BENCH_TRAFFIC_DEADLINE_S drain cap (default 120),
 BENCH_TRAFFIC_METRICS=1 to embed the merged metrics snapshot.
+
+Round 16: every line carries the analyzer's ``critical_path`` summary
+(straggler/phase-share/skew/BA-rounds — docs/OBSERVABILITY.md
+"Critical path & diagnosis") and ``trace_dropped`` (ring-overflow
+honesty: nonzero means the trace-derived numbers are partial), via the
+shared ``obs_extras`` plumbing.
 """
 
 from __future__ import annotations

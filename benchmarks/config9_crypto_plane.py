@@ -42,6 +42,12 @@ BENCH_CP_WINDOW_S (service batching window, default 0.002),
 BENCH_CP_SEED (default 0), BENCH_CP_DEADLINE_S (default 120),
 BENCH_CP_METRICS=1 to embed the merged metrics snapshot.  BENCH_TRACE
 / BENCH_OBS_PORT work as in config6/7.
+
+Round 16: every line carries the analyzer's ``critical_path`` summary
+— on the service arms its ``flush`` block folds the ``cryptoplane``
+track's per-epoch flush latency into the same object (the
+decrypt-after-order latency price, arxiv 2407.12172) — plus
+``trace_dropped`` (ring-overflow honesty), via ``obs_extras``.
 """
 
 from __future__ import annotations

@@ -742,7 +742,8 @@ class _EngineNetBase:
 
     # Engine TraceKind values (native/engine.cpp enum TraceKind) -> the
     # shared milestone taxonomy (docs/OBSERVABILITY.md).  d packs
-    # (round << 1) | value for coin/decide records.
+    # (round << 1) | value for input/coin/decide records.  Parity with
+    # the enum is machine-checked (tools/lint HBC005).
     TRACE_KIND_NAMES = {
         1: "epoch.open",
         2: "epoch.commit",
@@ -754,6 +755,7 @@ class _EngineNetBase:
         8: "ba.decide",
         9: "decrypt.start",
         10: "decrypt.done",
+        11: "ba.input",
     }
 
     def enable_trace(self, capacity: int = 8192) -> None:
@@ -797,7 +799,7 @@ class _EngineNetBase:
                 elif name == "ba.round":
                     args["proposer"] = c
                     args["round"] = d
-                elif name in ("ba.coin", "ba.decide"):
+                elif name in ("ba.coin", "ba.decide", "ba.input"):
                     args["proposer"] = c
                     args["round"] = d >> 1
                     args["value"] = d & 1

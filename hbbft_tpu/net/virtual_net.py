@@ -21,6 +21,7 @@ from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
 from hbbft_tpu.crypto.pool import VerifyPool
 from hbbft_tpu.crypto.suite import ScalarSuite, Suite
 from hbbft_tpu.net.adversary import Adversary, NullAdversary
+from hbbft_tpu.obs import trace as _trace
 from hbbft_tpu.protocols.fault_log import FaultLog
 from hbbft_tpu.protocols.network_info import NetworkInfo
 from hbbft_tpu.protocols.traits import ConsensusProtocol, Step
@@ -137,6 +138,11 @@ class VirtualNet:
         self._since_flush = 0
         self._dirty_pools: set = set()
         self.metrics = Metrics()
+        # Flight recorder (round 16): OFF by default — simulations pay
+        # one attribute read per crank.  enable_trace() gives each
+        # correct node a bounded ring; handlers run with that node's
+        # buffer swapped in (tracer ctx preserved per node).
+        self._traces: Optional[Dict[Any, Any]] = None
 
     # -- introspection -------------------------------------------------
     @property
@@ -172,7 +178,19 @@ class VirtualNet:
         assert node_id not in self.nodes and node_id not in self.faulty_ids
         node_rng = random.Random(self.rng.getrandbits(64))
         pool = VerifyPool()
+        if self._traces is not None:
+            # ring first, tracer swapped in DURING construction: the
+            # new protocol's own epoch.open (with whatever era its
+            # JoinPlan starts at) lands bracketed, unlike the original
+            # nodes whose construction pre-dated enable_trace
+            from hbbft_tpu.obs.trace import TraceBuffer
+
+            self._traces[node_id] = TraceBuffer(
+                f"node{node_id}", self._trace_capacity
+            )
+            self._swap_tracer(node_id)
         proto = factory(pool, node_rng)
+        self._swap_tracer(None)
         node = VirtualNode(
             id=node_id,
             netinfo=getattr(proto, "netinfo", None),
@@ -184,12 +202,46 @@ class VirtualNet:
         self.node_order = sorted(self.nodes) + sorted(self.faulty_ids)
         return node
 
+    # -- flight recorder (round 16) ------------------------------------
+    def enable_trace(self, capacity: int = 8192) -> None:
+        """Give every correct node a bounded milestone ring (the same
+        per-node tracks a LocalCluster records), for the sim-net golden
+        traces the critical-path analyzer is pinned against.  Call
+        BEFORE driving: protocol construction pre-dated the rings, so
+        each gets the (era 0, epoch 0) open re-emitted here — exactly
+        ClusterNode._run's first-epoch dance."""
+        from hbbft_tpu.obs.trace import TraceBuffer
+
+        self._trace_capacity = capacity
+        self._traces = {
+            nid: TraceBuffer(f"node{nid}", capacity)
+            for nid in sorted(self.nodes)
+        }
+        for buf in self._traces.values():
+            buf.emit("epoch.open", era=0, epoch=0)
+
+    def trace_events(self) -> Dict[str, List[Any]]:
+        """Snapshot of the per-node rings, keyed by track name (the
+        shape the obs exporters/analyzer consume); empty when tracing
+        was never enabled."""
+        if self._traces is None:
+            return {}
+        return {buf.track: buf.snapshot() for buf in self._traces.values()}
+
+    def _swap_tracer(self, node_id: Optional[Any]) -> None:
+        if self._traces is not None:
+            _trace.swap(
+                self._traces.get(node_id) if node_id is not None else None
+            )
+
     # -- driving -------------------------------------------------------
     def send_input(self, node_id: Any, input: Any) -> None:
         node = self.nodes[node_id]
+        self._swap_tracer(node_id)
         step = node.protocol.handle_input(input, node.rng)
         self._process_step(node, step)
         self._maybe_flush()
+        self._swap_tracer(None)
 
     def broadcast_input(self, input_fn: Callable[[Any], Any]) -> None:
         for nid in sorted(self.nodes):
@@ -223,10 +275,12 @@ class VirtualNet:
         node = self.nodes.get(msg.dest)
         if node is None:
             return True  # unknown destination: drop
+        self._swap_tracer(msg.dest)
         step = node.protocol.handle_message(msg.sender, msg.payload, node.rng)
         self.delivered += 1
         self._process_step(node, step)
         self._maybe_flush()
+        self._swap_tracer(None)
         return True
 
     def crank_until(
@@ -280,11 +334,15 @@ class VirtualNet:
             for nid in sorted(self._dirty_pools):
                 self._dirty_pools.discard(nid)
                 node = self.nodes.get(nid)
+                # flush continuations emit the node's own milestones
+                # (decrypt.done, epoch.commit) — swap its ring in
+                self._swap_tracer(nid if node is not None else None)
                 while node is not None and node.pool:
                     self.metrics.count("verify_requests", len(node.pool))
                     with self.metrics.timer("verify_flush"):
                         step = node.pool.flush(self.backend)
                     self._process_step(node, step)
+        self._swap_tracer(None)  # idle-path callers don't re-swap
 
 
 class NetBuilder:

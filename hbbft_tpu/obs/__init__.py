@@ -1,6 +1,11 @@
 """Flight recorder (round 12): cross-node epoch tracing + live scrape.
 
-Three pieces, usable separately:
+Round 16 adds :mod:`hbbft_tpu.obs.analyze` — the consensus
+critical-path analyzer and live stall diagnostician over the same
+rings (``/diag`` on the scrape server, ``tools/analyze.py`` for dumped
+traces).
+
+Four pieces, usable separately:
 
 * :mod:`hbbft_tpu.obs.trace` — a bounded per-node ring of structured
   protocol events (:class:`TraceBuffer`) plus the thread-local tracer
@@ -35,6 +40,13 @@ _EXPORTS = {
     "phase_summaries": "hbbft_tpu.obs.export",
     "write_chrome_trace": "hbbft_tpu.obs.export",
     "ObsServer": "hbbft_tpu.obs.server",
+    # round 16: critical-path analyzer + stall diagnostician
+    "critical_path": "hbbft_tpu.obs.analyze",
+    "summarize_critical_paths": "hbbft_tpu.obs.analyze",
+    "diagnose": "hbbft_tpu.obs.analyze",
+    "merge_diags": "hbbft_tpu.obs.analyze",
+    "derived_summaries": "hbbft_tpu.obs.analyze",
+    "tracks_from_chrome": "hbbft_tpu.obs.analyze",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -21,6 +21,16 @@ Endpoints:
   ``last_committed`` ``[era, epoch]`` (null before the first commit);
   top-level ``ok`` is true iff every non-Byzantine node is alive.
   Status 200 when ok, 503 otherwise (load-balancer semantics).
+* ``GET /diag`` — the live stall diagnosis
+  (:func:`~hbbft_tpu.obs.analyze.diagnose` over the SAME rings the
+  trace export reads, so live and post-mortem analysis can never
+  disagree): ``stalled`` / ``since_s``, the open epoch per node,
+  per-instance stuck phases (which proposer's RBC is incomplete, which
+  BA is stuck at which round), link state, and a ``verdict`` naming
+  the most-implicated (proposer, phase) when stalled.
+  ``?stall_s=<seconds>`` overrides the quiescence threshold (default
+  5 s).  Always HTTP 200 — a diagnosis of "stalled" is a successful
+  scrape.
 
 Tests drive these with ``urllib`` against a driven N=4 cluster
 (tests/test_obs.py); benchmarks expose them via ``BENCH_OBS_PORT``.
@@ -32,6 +42,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
+from urllib.parse import parse_qs
 
 
 class ObsServer:
@@ -77,6 +88,19 @@ class ObsServer:
                             json.dumps(health).encode(),
                             "application/json",
                         )
+                    elif path == "/diag":
+                        qs = parse_qs(
+                            self.path.partition("?")[2], keep_blank_values=False
+                        )
+                        try:
+                            stall_s = float(qs["stall_s"][0])
+                        except (KeyError, ValueError, IndexError):
+                            stall_s = 5.0
+                        self._reply(
+                            200,
+                            json.dumps(obs.diag(stall_s)).encode(),
+                            "application/json",
+                        )
                     else:
                         self._reply(404, b"not found\n", "text/plain")
                 except Exception as exc:  # a scrape bug must not kill the run
@@ -98,6 +122,36 @@ class ObsServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def diag(self, stall_after_s: float = 5.0) -> dict:
+        """The live stall diagnosis for ``/diag``: the cluster's own
+        :meth:`diag` when it has one (LocalCluster), else
+        :func:`~hbbft_tpu.obs.analyze.diagnose` over its rings (the
+        single-node worker view, which carries the cluster's real
+        consensus size as ``consensus_n``).  Dead HONEST protocol
+        threads from the health probe ride along — a diagnosis that
+        names a stuck proposer but hides a crashed node would mislead."""
+        c = self.cluster
+        own = getattr(c, "diag", None)
+        if callable(own):
+            d = own(stall_after_s)
+        else:
+            from hbbft_tpu.obs.analyze import diagnose
+
+            d = diagnose(
+                c.trace_events(),
+                n=getattr(c, "consensus_n", None) or getattr(c, "n", None),
+                stall_after_s=stall_after_s,
+            )
+        _ok, health = self.health()
+        dead = sorted(
+            int(i)
+            for i, st in health["nodes"].items()
+            if not st["alive"] and not st.get("byzantine")
+        )
+        if dead:
+            d["dead_nodes"] = dead
+        return d
 
     def health(self) -> Tuple[bool, dict]:
         c = self.cluster

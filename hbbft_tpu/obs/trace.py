@@ -102,15 +102,39 @@ class _Tracer(threading.local):
 
     def __init__(self) -> None:  # fresh ctx per thread
         self.ctx: Dict[str, Any] = {}
+        # Saved contexts for swap(): single-thread simulators interleave
+        # many nodes on one thread, and each node's accumulated ctx
+        # (era, set at construction / era change) must survive the
+        # interleaving.  Keyed by the buffer object (alive for the sim's
+        # lifetime); install() clears it.
+        self.saved: Dict[Any, Dict[str, Any]] = {}
 
 
 _TLS = _Tracer()
 
 
 def install(buf: Optional[TraceBuffer]) -> None:
-    """Install ``buf`` as this thread's tracer (None uninstalls)."""
+    """Install ``buf`` as this thread's tracer (None uninstalls).  The
+    context starts fresh; any swap() save-space is dropped."""
     _TLS.buf = buf
     _TLS.ctx = {}
+    _TLS.saved = {}
+
+
+def swap(buf: Optional[TraceBuffer]) -> None:
+    """Switch this thread's tracer to ``buf``, PRESERVING each buffer's
+    accumulated context across switches (unlike :func:`install`, which
+    resets it).  This is the simulator hand-off: VirtualNet runs every
+    node on one thread and swaps the matching buffer in around each
+    handler call, so a node's era ctx (set once at construction or era
+    change) keeps attributing its later emits."""
+    t = _TLS
+    if t.buf is buf:
+        return
+    if t.buf is not None:
+        t.saved[t.buf] = t.ctx
+    t.buf = buf
+    t.ctx = t.saved.pop(buf, {}) if buf is not None else {}
 
 
 def emit(name: str, **args: Any) -> None:

@@ -116,6 +116,12 @@ class BinaryAgreement(ConsensusProtocol):
         if self._estimate is not None or self._terminated:
             return Step.empty()
         self._estimate = bool(input)
+        # Flight-recorder milestone (round 16): a BA instance stuck at
+        # round 0 emits no ba.round (that fires on ADVANCE), so without
+        # this the stall diagnostician cannot tell "BA started, stuck"
+        # from "BA never received its input".  Mirrored by the native
+        # engine's TR_BA_INPUT.
+        _trace.emit("ba.input", round=self._round, value=int(input))
         return self._wrap(self._sbv.input(self._estimate))
 
     def handle_message(self, sender: Any, message: AbaMessage, rng: Any) -> Step:

@@ -55,7 +55,8 @@ from hbbft_tpu.crypto.keys import SecretKey, SecretKeySet
 from hbbft_tpu.crypto.pool import VerifyPool
 from hbbft_tpu.crypto.suite import ScalarSuite, Suite
 from hbbft_tpu.obs import trace as _trace
-from hbbft_tpu.obs.export import chrome_trace, phase_summaries, summarize
+from hbbft_tpu.obs.analyze import derived_summaries, diagnose
+from hbbft_tpu.obs.export import chrome_trace, summarize
 from hbbft_tpu.obs.trace import TraceBuffer, TraceEvent
 from hbbft_tpu.protocols.dynamic_honey_badger import DhbBatch
 from hbbft_tpu.protocols.network_info import NetworkInfo
@@ -121,11 +122,14 @@ def track_commits(
 def merge_node_metrics(
     nodes: Dict[int, Any],
     base: Optional[Metrics] = None,
-    phases: Optional[Dict[str, Tuple[Dict[float, float], int, float]]] = None,
+    summaries: Optional[
+        Dict[str, Tuple[Dict[float, float], int, float]]
+    ] = None,
 ) -> Metrics:
     """Merge per-node metrics plus the derived observability families
     (per-node transport export, ``epoch.latency`` summary, per-node
-    committed gauges, ``phase.*`` summaries) — the shared half of
+    committed gauges, the ring-derived ``summaries`` — ``phase.*`` +
+    ``ba.rounds``) — the shared half of
     :meth:`LocalCluster.merged_metrics`, factored out so the
     process-per-node worker (:mod:`~hbbft_tpu.transport.cluster_worker`)
     exports the same metric families for ONE node that a cluster dump
@@ -137,19 +141,29 @@ def merge_node_metrics(
     if base is not None:
         m.merge(base)
     lats: List[float] = []
+    dropped_total = 0
     for i, node in nodes.items():
+        # Trace-ring overflow (round-16 satellite): silently truncated
+        # traces make every ring-derived number (phase.*, ba.rounds,
+        # critical_path) quietly partial — export the drop counters so
+        # a scrape or bench line shows the truncation.
+        drop_fn = getattr(node, "trace_dropped", None)
+        dropped = int(drop_fn()) if callable(drop_fn) else 0
+        m.gauge(f"trace.{i}.dropped", dropped)
+        dropped_total += dropped
         tracker = getattr(node, "epochs", None)
         if tracker is None:
             continue
         node_lats = tracker.latencies()
         lats.extend(node_lats)
         m.gauge(f"epoch.{i}.committed", len(node_lats))
+    m.gauge("trace.dropped", dropped_total)
     sm = summarize(lats)
     if sm is not None:
         quant, count, total = sm
         m.summary("epoch.latency", quant, count, total)
-    for phase, (quant, count, total) in sorted((phases or {}).items()):
-        m.summary(f"phase.{phase}", quant, count, total)
+    for name, (quant, count, total) in sorted((summaries or {}).items()):
+        m.summary(name, quant, count, total)
     return m
 
 
@@ -258,6 +272,11 @@ class ClusterNode:
                 return None
             b = self._batches[-1]
             return (b.era, b.epoch)
+
+    def trace_dropped(self) -> int:
+        """Events this node's trace ring dropped to overflow (0 when
+        the recorder is off) — the honest-truncation gauge."""
+        return self.trace.dropped if self.trace is not None else 0
 
     def _track_commits(self, batches: List[DhbBatch]) -> None:
         if batches:
@@ -743,23 +762,23 @@ class LocalCluster:
         summaries.  ``fresh=True`` bypasses the phase-summary TTL cache
         — end-of-run snapshots (benchmark JSON lines) must be exact
         even when a live scraper primed the cache seconds earlier."""
-        # phase.* (round 12): the per-epoch phase-latency breakdown
-        # derived from the flight-recorder rings (rbc / ba / coin /
-        # decrypt / epoch spans — obs/export.py), TTL-cached so a
-        # polling scraper pays the ring walk at most once per 2 s.
+        # phase.* (round 12) + ba.rounds (round 16): the per-epoch
+        # ring-derived summaries (obs/export.py + obs/analyze.py),
+        # TTL-cached so a polling scraper pays the ring walk at most
+        # once per 2 s.
         now = time.monotonic()
         # local read: stop() clears the attribute from another thread
         # between a scrape handler's check and its dereference
         cache = self._phase_cache
         if not fresh and cache is not None and now < cache[0]:
-            phases = cache[1]
+            sums = cache[1]
         else:
-            phases = phase_summaries(self.trace_events())
-            self._phase_cache = (now + 2.0, phases)
+            sums = derived_summaries(self.trace_events())
+            self._phase_cache = (now + 2.0, sums)
         # epoch.latency + per-node export (round 12) via the shared
         # merge helper; the cluster-only extras (injector, crypto
         # service) layer on top.
-        m = merge_node_metrics(self.nodes, base=self.metrics, phases=phases)
+        m = merge_node_metrics(self.nodes, base=self.metrics, summaries=sums)
         if self.injector is not None:
             # injected-fault totals land in the same Prometheus dump as
             # the transport/cluster counters (faults.* gauges)
@@ -791,6 +810,16 @@ class LocalCluster:
         node; loads in Perfetto / ``chrome://tracing``)."""
         pids = {self.traces[i].track: i for i in self.traces}
         return chrome_trace(self.trace_events(), pids=pids)
+
+    def diag(self, stall_after_s: float = 5.0) -> Dict[str, Any]:
+        """The live stall diagnosis (obs/analyze.py) over this
+        cluster's rings: stalled?, the open epoch per node, which
+        proposer's RBC / BA / decrypt each node is waiting on, link
+        state, and a verdict naming the most-implicated (proposer,
+        phase).  Served as ``/diag`` by :meth:`serve_obs`."""
+        return diagnose(
+            self.trace_events(), n=self.n, stall_after_s=stall_after_s
+        )
 
     def write_trace(self, path: str) -> str:
         """Write :meth:`chrome_trace` to ``path``; returns the path."""

@@ -57,7 +57,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from hbbft_tpu.crypto.backend import BatchedBackend
 from hbbft_tpu.crypto.suite import ScalarSuite
-from hbbft_tpu.obs.export import chrome_trace, phase_summaries
+from hbbft_tpu.obs.analyze import derived_summaries
+from hbbft_tpu.obs.export import chrome_trace
 from hbbft_tpu.obs.trace import TraceBuffer
 from hbbft_tpu.protocols.queueing_honey_badger import Input
 from hbbft_tpu.transport.cluster import (
@@ -76,10 +77,20 @@ class _SoloClusterView:
     expect from :class:`~hbbft_tpu.transport.cluster.LocalCluster`,
     backed by THIS process's one node."""
 
-    def __init__(self, node_id: int, node: Any, trace: TraceBuffer) -> None:
+    def __init__(
+        self,
+        node_id: int,
+        node: Any,
+        trace: TraceBuffer,
+        consensus_n: Optional[int] = None,
+    ) -> None:
         self.node_id = node_id
         self.nodes = {node_id: node}
         self.n = 1
+        # The CLUSTER's consensus size (proposer universe) — this view
+        # holds one node, but its /diag must reason about all N
+        # proposers' instances on this node's timeline.
+        self.consensus_n = consensus_n
         self.byzantine: Dict[int, Any] = {}
         self.trace = trace
         # Same 2 s phase-summary TTL cache as LocalCluster: a polling
@@ -102,11 +113,11 @@ class _SoloClusterView:
         now = time.monotonic()
         cache = self._phase_cache
         if not fresh and cache is not None and now < cache[0]:
-            phases = cache[1]
+            sums = cache[1]
         else:
-            phases = phase_summaries(self.trace_events())
-            self._phase_cache = (now + 2.0, phases)
-        return merge_node_metrics(self.nodes, phases=phases)
+            sums = derived_summaries(self.trace_events())
+            self._phase_cache = (now + 2.0, sums)
+        return merge_node_metrics(self.nodes, summaries=sums)
 
     def chrome_trace(self) -> Dict[str, Any]:
         return chrome_trace(
@@ -263,7 +274,7 @@ def main(argv=None) -> int:
             trace=trace,
         )
 
-    view = _SoloClusterView(node_id, node, trace)
+    view = _SoloClusterView(node_id, node, trace, consensus_n=n)
     obs_server = None
     obs_port: Optional[int] = None
     if args.obs_port is not None:
@@ -371,6 +382,9 @@ def main(argv=None) -> int:
             "accepts": m.counters.get("transport.accepts", 0),
             "bad_payload": m.counters.get("cluster.bad_payload", 0),
             "handler_errors": m.counters.get("cluster.handler_errors", 0),
+            # ring-overflow honesty: nonzero means this node's trace
+            # (and everything derived from it) is silently partial
+            "trace_dropped": int(m.gauges.get("trace.dropped", 0)),
             "wall_s": round(wall, 3),
         }
         if args.metrics:

@@ -126,6 +126,10 @@ class NativeClusterNode:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._synced_faults = 0  # engine fault entries already exported
+        # Engine-ring drop count, cached by the protocol thread's sync
+        # (the engine is not thread-safe, so trace_dropped() must not
+        # call into it from a scraper thread); GIL-atomic int read.
+        self._engine_trace_dropped = 0
         transport.on_batch = self._on_frame_burst
 
     # -- transport thread ----------------------------------------------
@@ -163,6 +167,13 @@ class NativeClusterNode:
             return None
         b = outs[-1]  # GIL-atomic tail read of an append-only list
         return (b.era, b.epoch)
+
+    def trace_dropped(self) -> int:
+        """Total trace events lost to overflow: the Python ring's drop
+        count plus the engine ring's (as of the last protocol-thread
+        sync) — the honest-truncation gauge behind ``trace.<i>.dropped``."""
+        py = self.trace.dropped if self.trace is not None else 0
+        return py + self._engine_trace_dropped
 
     def start(self) -> None:
         assert self._thread is None
@@ -293,6 +304,7 @@ class NativeClusterNode:
             events = eng.drain_trace()
             if events:
                 self.trace.extend(events)
+            self._engine_trace_dropped = eng.trace_dropped
         now = time.monotonic()
         # Also publish on commit sweeps (at most once per epoch): a
         # mid-run scrape right after an epoch lands must see its cycles

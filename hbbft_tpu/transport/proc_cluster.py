@@ -322,6 +322,35 @@ class ProcCluster:
         ) as resp:
             return resp.read()
 
+    def diag(self, stall_after_s: float = 5.0) -> Dict[str, Any]:
+        """Parent-side cluster diagnosis: scrape every live worker's
+        ``/diag`` (the analyzer over ITS rings, with the cluster's real
+        consensus size) and fold them with
+        :func:`~hbbft_tpu.obs.analyze.merge_diags` — the same verdict
+        rule as a thread-mode cluster, so both runtimes name the same
+        stuck (proposer, phase).  Dead workers are reported, not
+        scraped (requires ``obs=True``)."""
+        from hbbft_tpu.obs.analyze import merge_diags
+
+        per_worker: Dict[int, Optional[dict]] = {}
+        dead: List[int] = []
+        for i, w in self.workers.items():
+            if w.proc.poll() is not None or not w.obs_port:
+                dead.append(i)
+                continue
+            try:
+                per_worker[i] = json.loads(
+                    self.scrape(i, f"/diag?stall_s={stall_after_s}")
+                )
+            except Exception:
+                dead.append(i)  # mid-scrape death: same as dead
+        merged = merge_diags(
+            list(per_worker.values()), stall_after_s=stall_after_s
+        )
+        if dead:
+            merged["dead_nodes"] = sorted(dead)
+        return merged
+
     def merged_chrome_trace(self) -> Dict[str, Any]:
         """Merge the per-worker trace files (``trace_dir`` mode) into
         one Chrome trace on the shared wall clock."""

@@ -1061,6 +1061,7 @@ enum TraceKind : int32_t {
   TR_BA_DECIDE = 8,      // a=era, b=epoch, c=proposer, d=(round<<1)|value
   TR_DECRYPT_START = 9,  // a=era, b=epoch, c=proposer
   TR_DECRYPT_DONE = 10,  // a=era, b=epoch, c=proposer
+  TR_BA_INPUT = 11,      // a=era, b=epoch, c=proposer, d=(round<<1)|est
 };
 
 struct TraceRec {
@@ -2305,6 +2306,11 @@ struct Ctx {
   void ba_input(EpochState& st, int proposer, Ba& ba, bool input) {
     if (ba.estimate >= 0 || ba.terminated) return;
     ba.estimate = input ? 1 : 0;
+    // Round-16 stall diagnosis: a BA instance stuck at round 0 emits no
+    // TR_BA_ROUND (that fires on advance) — this is the "BA started"
+    // marker.  Mirrors the Python arm's "ba.input" milestone.
+    trace_emit(e, node.id, TR_BA_INPUT, node.era, st.epoch, proposer,
+               (ba.round << 1) | (input ? 1 : 0));
     std::vector<uint8_t> outs;
     sbv_input(st, proposer, ba.round, ba.sbv, input, outs);
     ba_consume_sbv(st, proposer, ba, outs);

@@ -582,6 +582,57 @@ def test_engine_patched_unlocked_cache_access_flagged(engine_src):
 
 
 # ---------------------------------------------------------------------------
+# HBC005: TraceKind <-> exporter taxonomy parity
+# ---------------------------------------------------------------------------
+
+
+def test_cxx_fixture_without_trace_enum_skips_taxonomy():
+    f = [x for x in lint_source(CXX_FIXTURE, "fixture.cpp") if x.rule == "HBC005"]
+    assert f == []
+
+
+def test_engine_taxonomy_is_in_parity(engine_src):
+    assert [
+        x.render() for x in lint_source(engine_src) if x.rule == "HBC005"
+    ] == []
+
+
+def test_engine_patched_new_trace_kind_without_exporter_entry_flagged(
+    engine_src,
+):
+    # Adding an enum value without teaching the exporter its name must
+    # fail: the event would surface as opaque engine.k99 and every
+    # ring-derived analysis would silently miss it.
+    patched = engine_src.replace(
+        "enum TraceKind : int32_t {",
+        "enum TraceKind : int32_t {\n  TR_SNEAKY_THING = 99,",
+    )
+    f = [x for x in lint_source(patched) if x.rule == "HBC005"]
+    assert any(
+        "TR_SNEAKY_THING" in x.message and "engine.k99" in x.message
+        for x in f
+    )
+    # ...and the missing docs-table row is reported too
+    assert any("sneaky.thing" in x.message for x in f)
+
+
+def test_engine_removed_trace_kind_leaves_dead_exporter_row(engine_src):
+    # Removing an enum value (here: renumbering TR_BA_INPUT away) while
+    # TRACE_KIND_NAMES still maps it must flag the dead taxonomy row.
+    patched = engine_src.replace("TR_BA_INPUT = 11,", "TR_BA_INPUT = 63,")
+    f = [x for x in lint_source(patched) if x.rule == "HBC005"]
+    assert any("11" in x.message and "dead taxonomy row" in x.message for x in f)
+
+
+def test_trace_enum_name_mapping_rule():
+    from tools.lint.cxxlints import _enum_to_name
+
+    assert _enum_to_name("TR_EPOCH_OPEN") == "epoch.open"
+    assert _enum_to_name("TR_BA_INPUT") == "ba.input"
+    assert _enum_to_name("TR_DECRYPT_START") == "decrypt.start"
+
+
+# ---------------------------------------------------------------------------
 # Whole-repo gates
 # ---------------------------------------------------------------------------
 

@@ -28,10 +28,11 @@ close enough that a reviewer sees claim and use together.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Dict, List, Optional, Tuple
 
-from tools.lint import Finding
+from tools.lint import Finding, _REPO
 from tools.lint.slot_registry import CLAIMED_SLOTS, FREE_SLOTS, TYPED_DELIVERY_SLOTS
 
 # Structs whose reset exhaustiveness is checked, with their reset method
@@ -658,10 +659,159 @@ def rule_slot_registry(
 
 
 # ---------------------------------------------------------------------------
+# HBC005: trace-event taxonomy parity (enum TraceKind <-> exporter table)
+# ---------------------------------------------------------------------------
+
+_TRACE_ENUM_OPEN_RE = re.compile(r"\benum\s+TraceKind\b")
+_TRACE_ENTRY_RE = re.compile(r"\b(TR_[A-Z0-9_]+)\s*=\s*(\d+)")
+_EXPORTER_REL = os.path.join("hbbft_tpu", "native_engine.py")
+_TAXONOMY_DOC_REL = os.path.join("docs", "OBSERVABILITY.md")
+
+
+def _enum_to_name(entry: str) -> str:
+    """``TR_EPOCH_OPEN`` -> ``epoch.open`` (the documented mapping:
+    strip the prefix, lowercase, underscores become dots)."""
+    return entry[len("TR_"):].lower().replace("_", ".")
+
+
+def _parse_trace_enum(
+    code_lines: List[str],
+) -> Optional[Dict[int, Tuple[str, int]]]:
+    """value -> (TR_ name, line) from the ``enum TraceKind`` block;
+    None when the source has no such enum (fixtures)."""
+    for ln, line in enumerate(code_lines, 1):
+        if _TRACE_ENUM_OPEN_RE.search(line):
+            out: Dict[int, Tuple[str, int]] = {}
+            for off, body in enumerate(code_lines[ln - 1:]):
+                for m in _TRACE_ENTRY_RE.finditer(body):
+                    out[int(m.group(2))] = (m.group(1), ln + off)
+                if "}" in body:
+                    return out
+            return out
+    return None
+
+
+def _exporter_table() -> Optional[Dict[int, str]]:
+    """The ``TRACE_KIND_NAMES`` dict literal from native_engine.py,
+    parsed via ast (never imported — lint must not load ctypes libs)."""
+    import ast
+
+    path = os.path.join(_REPO, _EXPORTER_REL)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "TRACE_KIND_NAMES"
+            for t in node.targets
+        ):
+            try:
+                return ast.literal_eval(node.value)
+            except ValueError:
+                return None
+    return None
+
+
+def rule_trace_taxonomy(
+    code_lines: List[str], raw_lines: List[str], path: str
+) -> List[Finding]:
+    """Every ``TraceKind`` enum value must have a matching entry in the
+    exporter's taxonomy table (``native_engine.TRACE_KIND_NAMES``) and
+    vice versa, and every mapped name must appear in the
+    docs/OBSERVABILITY.md taxonomy table — the shared-taxonomy contract
+    was prose-only before round 16.  A kind the exporter cannot name
+    surfaces as an opaque ``engine.k<N>`` event; a name the engine never
+    emits is a dead taxonomy row."""
+    enum = _parse_trace_enum(code_lines)
+    if enum is None:
+        return []  # fixture / partial source: nothing to check
+    findings: List[Finding] = []
+    table = _exporter_table()
+    if table is None:
+        return [
+            Finding(
+                "HBC005",
+                path,
+                1,
+                f"cannot parse TRACE_KIND_NAMES from {_EXPORTER_REL}:"
+                " the TraceKind taxonomy check needs the exporter table"
+                " as a plain dict literal",
+            )
+        ]
+    try:
+        with open(
+            os.path.join(_REPO, _TAXONOMY_DOC_REL), "r", encoding="utf-8"
+        ) as f:
+            doc = f.read()
+    except OSError:
+        doc = ""
+    for value, (entry, ln) in sorted(enum.items()):
+        want = _enum_to_name(entry)
+        got = table.get(value)
+        if got is None:
+            findings.append(
+                Finding(
+                    "HBC005",
+                    path,
+                    ln,
+                    f"TraceKind {entry} = {value} has no entry in"
+                    f" {_EXPORTER_REL} TRACE_KIND_NAMES: the exporter"
+                    f" would surface it as opaque engine.k{value} —"
+                    f" add {value}: \"{want}\" (and decode its args)",
+                )
+            )
+        elif got != want:
+            findings.append(
+                Finding(
+                    "HBC005",
+                    path,
+                    ln,
+                    f"TraceKind {entry} = {value} maps to"
+                    f" {got!r} in TRACE_KIND_NAMES but the naming rule"
+                    f" (strip TR_, lowercase, '_' -> '.') says {want!r}:"
+                    " rename one side so grep finds both",
+                )
+            )
+        if f"`{want}`" not in doc:
+            findings.append(
+                Finding(
+                    "HBC005",
+                    path,
+                    ln,
+                    f"milestone `{want}` ({entry}) is missing from the"
+                    f" {_TAXONOMY_DOC_REL} event-taxonomy table: document"
+                    " its args and emit point",
+                )
+            )
+    for value, name in sorted(table.items()):
+        if value not in enum:
+            findings.append(
+                Finding(
+                    "HBC005",
+                    path,
+                    1,
+                    f"TRACE_KIND_NAMES maps {value} -> {name!r} but"
+                    f" enum TraceKind has no value {value}: dead taxonomy"
+                    " row (or the engine entry was removed without the"
+                    " exporter)",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
-_RULES = (rule_field_reset, rule_prof_guard, rule_lock_guard, rule_slot_registry)
+_RULES = (
+    rule_field_reset,
+    rule_prof_guard,
+    rule_lock_guard,
+    rule_slot_registry,
+    rule_trace_taxonomy,
+)
 
 
 def lint_source(src: str, path: str = "native/engine.cpp") -> List[Finding]:

@@ -1,0 +1,135 @@
+"""Regenerate the golden sim-net trace fixtures for tests/test_analyze.py.
+
+Runs a deterministic N=4 QHB simulation on BOTH sim-net impls — the
+Python :class:`~hbbft_tpu.net.virtual_net.VirtualNet` (with the
+round-16 per-node tracer) and the native :class:`~hbbft_tpu.
+native_engine.NativeQhbNet` (engine ring) — records each run's trace
+tracks, and writes:
+
+* ``tests/fixtures/golden_trace_<impl>.json`` — the frozen event
+  streams (timestamps are wall clock, frozen at generation time; the
+  event STRUCTURE is seed-deterministic);
+* ``tests/fixtures/golden_cp_<impl>.json`` — the critical-path
+  analyzer's output over those exact streams, which
+  tests/test_analyze.py pins byte-for-byte (after a JSON round trip).
+
+Regenerate ONLY when the analyzer's output schema or the milestone
+taxonomy deliberately changes:
+
+    python tools/make_golden_trace.py
+
+and commit both file pairs together with the change that moved them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hbbft_tpu.obs.analyze import critical_path  # noqa: E402
+from hbbft_tpu.obs.trace import TraceEvent  # noqa: E402
+
+FIXDIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests",
+    "fixtures",
+)
+SEED = 0
+N = 4
+EPOCHS = 3
+BATCH = 4
+
+
+def gen_python_tracks() -> Dict[str, List[TraceEvent]]:
+    from hbbft_tpu.net import NetBuilder
+    from hbbft_tpu.protocols.queueing_honey_badger import (
+        Input,
+        QueueingHoneyBadger,
+    )
+    from hbbft_tpu.protocols.sender_queue import SenderQueue
+
+    def factory(ni: Any, sink: Any, rng: Any) -> Any:
+        return SenderQueue.wrap(
+            lambda s: QueueingHoneyBadger(
+                ni, s, batch_size=BATCH, session_id=b"golden"
+            ),
+            sink,
+            peers=list(range(N)),
+        )
+
+    net = NetBuilder(N, seed=SEED).num_faulty(0).protocol(factory).build()
+    net.enable_trace()
+    for i in range(N):
+        net.send_input(i, Input.user(f"g-0-{i}"))
+        net.send_input(i, Input.user(f"g-1-{i}"))
+    net.crank_until(
+        lambda n: all(len(n.node(i).outputs) >= EPOCHS for i in range(N)),
+        max_cranks=200_000,
+    )
+    return net.trace_events()
+
+
+def gen_native_tracks() -> Dict[str, List[TraceEvent]]:
+    from hbbft_tpu.native_engine import NativeQhbNet
+    from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+    net = NativeQhbNet(N, seed=SEED, batch_size=BATCH, num_faulty=0)
+    net.enable_trace(65536)
+    for i in range(N):
+        net.send_input(i, Input.user(f"g-0-{i}"))
+        net.send_input(i, Input.user(f"g-1-{i}"))
+    # small chunks: QHB commits empty epochs forever if the predicate is
+    # only checked after a huge run (CLAUDE.md run_until note)
+    net.run_until(
+        lambda n: all(
+            len(n.nodes[i].outputs) >= EPOCHS for i in range(N)
+        ),
+        chunk=2_000,
+    )
+    tracks: Dict[str, List[TraceEvent]] = {}
+    for ev in net.drain_trace():
+        tracks.setdefault(f"node{ev.args['node']}", []).append(ev)
+    return tracks
+
+
+def dump(impl: str, tracks: Dict[str, List[TraceEvent]]) -> None:
+    os.makedirs(FIXDIR, exist_ok=True)
+    ser = {
+        "impl": impl,
+        "seed": SEED,
+        "n": N,
+        "tracks": {
+            t: [[ev.ts, ev.name, ev.args] for ev in evs]
+            for t, evs in sorted(tracks.items())
+        },
+    }
+    trace_path = os.path.join(FIXDIR, f"golden_trace_{impl}.json")
+    with open(trace_path, "w") as fh:
+        json.dump(ser, fh, indent=1, sort_keys=True)
+    recs = critical_path(tracks)
+    cp_path = os.path.join(FIXDIR, f"golden_cp_{impl}.json")
+    with open(cp_path, "w") as fh:
+        json.dump(recs, fh, indent=1, sort_keys=True)
+    print(
+        f"{impl}: {sum(len(v) for v in tracks.values())} events, "
+        f"{len(recs)} epochs -> {trace_path}, {cp_path}"
+    )
+
+
+def main() -> int:
+    dump("python", gen_python_tracks())
+    try:
+        tracks = gen_native_tracks()
+    except RuntimeError as exc:  # no compiler on this box
+        print(f"native fixture SKIPPED: {exc}", file=sys.stderr)
+        return 1
+    dump("native", tracks)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
