@@ -15,6 +15,13 @@ lint:
 		echo "ruff not installed; skipped (invariant lint ran)"; \
 	fi
 
+# Pre-commit aggregate: the invariant + cross-language contract linter
+# (HBT/HBC/HBX rules, incl. the knob-doc staleness gate), ruff when
+# installed, then the two no-jax smoke tiers.  Safe during crypto-cache
+# cold states — nothing here compiles XLA graphs.
+check: lint cluster-smoke obs-smoke
+	@echo "make check: all gates green"
+
 asan ubsan tsan:
 	$(MAKE) -C native $@
 
@@ -79,5 +86,5 @@ cryptoplane-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cryptoplane.py \
 		-q -m 'not slow'
 
-.PHONY: lint asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
+.PHONY: lint check asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
 	chaos-smoke obs-smoke cryptoplane-smoke diag

@@ -183,6 +183,9 @@ class SenderQueue(ConsensusProtocol):
             self._route(step, peer, msg_epoch, msg)
         return step
 
+    # mirror: sq-admission — this send/hold/drop window decision is
+    #     mirrored by `cluster_admit` in native/engine.cpp; divergence
+    #     makes the two impls deliver different message sets.
     def _admits(self, peer_epoch: EpochId, msg_epoch: EpochId) -> str:
         """'send' | 'hold' | 'drop' for a message vs a peer's window."""
         if msg_epoch[0] < peer_epoch[0]:

@@ -95,6 +95,10 @@ class ThresholdDecrypt(ConsensusProtocol):
         )
         return step
 
+    # mirror: td-acceptance-item — the acceptance rules below (who is
+    #     counted, when faults fire, the terminated gate) are mirrored
+    #     by the engine's per-item continuation (`td_verified_cb` in
+    #     native/engine.cpp); HBX003 keeps the pair of anchors alive.
     def handle_message(self, sender: Any, message: DecryptMessage, rng: Any) -> Step:
         step = Step.empty()
         if self._terminated:
@@ -142,6 +146,9 @@ class ThresholdDecrypt(ConsensusProtocol):
             lambda ok, s=sender, sh=share: self._on_verified(s, sh, ok),
         )
 
+    # mirror: td-acceptance-group — the same rules applied to a deferred
+    #     RLC group verdict are mirrored by `td_group_verified_cb` in
+    #     native/engine.cpp (per-sender attribution through bisection).
     def _on_verified(self, sender: Any, share: DecryptionShare, ok: bool) -> Step:
         step = Step.empty()
         if self._terminated:

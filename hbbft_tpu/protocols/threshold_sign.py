@@ -99,6 +99,10 @@ class ThresholdSign(ConsensusProtocol):
             step.extend(self._try_output())
         return step
 
+    # mirror: ts-acceptance-item — the acceptance rules below (who is
+    #     counted, when faults fire, the terminated gate) are mirrored
+    #     by the engine's per-item continuation (`ts_verified_cb` in
+    #     native/engine.cpp); HBX003 keeps the pair of anchors alive.
     def handle_message(self, sender: Any, message: SignMessage, rng: Any) -> Step:
         step = Step.empty()
         if self._terminated:
@@ -122,6 +126,9 @@ class ThresholdSign(ConsensusProtocol):
         return step
 
     # -- internal ------------------------------------------------------
+    # mirror: ts-acceptance-group — the same rules applied to a deferred
+    #     RLC group verdict are mirrored by `ts_group_verified_cb` in
+    #     native/engine.cpp (per-sender attribution through bisection).
     def _on_verified(self, sender: Any, share: SignatureShare, ok: bool) -> Step:
         step = Step.empty()
         if self._terminated:

@@ -33,6 +33,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Tuple, Type
 
+# mirror: serde-scan-limits — these two constants are passed verbatim
+#     to the native token scan (`_native_scan` below) and duplicated as
+#     literals at engine.cpp's own hbe_serde_scan call site; HBX001
+#     checks the values match, HBX003 keeps the anchors paired.
 MAX_DEPTH = 64
 _MAX_LEN = 1 << 28  # 256 MiB hard cap on any single length field
 

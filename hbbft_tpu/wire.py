@@ -725,24 +725,47 @@ def _unpack_sq_msg(f: tuple) -> SqMessage:
 
 
 # -- registration -----------------------------------------------------------
+# mirror: wire-grammar — this registration table IS the Python half of
+#     the wire grammar; the C++ half is engine.cpp's wire codec
+#     (wenc_* emitters + WireWalk acceptance).  HBX001 diffs the two
+#     tag sets; tags the engine carries only as opaque committed-
+#     contribution bytes are annotated `# lint: wire-oneside (...)`.
 
+# lint: wire-oneside (engine carries ciphertexts as opaque contribution
+#     bytes; only the Python batch path decodes them)
 register_struct("ct", Ciphertext, _pack_ciphertext, _unpack_ciphertext)
 register_token_struct("ct", _fast_build_ct)
+# lint: wire-oneside (combined signatures live inside committed batches,
+#     opaque to the engine wire codec)
 register_struct("sig", Signature, _pack_signature, _unpack_signature)
 register_struct("pk", PublicKey, _pack_public_key, _unpack_public_key)
 register_struct("comm", Commitment, _pack_commitment, _unpack_commitment)
+# lint: wire-oneside (DKG bivariate commitments ride inside Part/Ack
+#     contribution payloads the engine never parses)
 register_struct(
     "bicomm", BivarCommitment, _pack_bivar_commitment, _unpack_bivar_commitment
 )
 register_struct("encsched", EncryptionSchedule, _pack_schedule, _unpack_schedule)
+# lint: wire-oneside (DHB vote payloads are committed-batch content,
+#     opaque contribution bytes to the engine)
 register_struct("change", Change, _pack_change, _unpack_change)
+# lint: wire-oneside (signed votes are committed-batch content, opaque
+#     contribution bytes to the engine)
 register_struct("svote", SignedVote, _pack_signed_vote, _unpack_signed_vote)
+# lint: wire-oneside (signed key-gen messages are committed-batch
+#     content, opaque contribution bytes to the engine)
 register_struct("skg", SignedKeyGenMsg, _pack_signed_kg, _unpack_signed_kg)
+# lint: wire-oneside (InternalContrib is the committed-contribution
+#     envelope itself — the engine hands its bytes to Python whole)
 register_struct(
     "icontrib", InternalContrib, _pack_internal_contrib, _unpack_internal_contrib
 )
 register_struct("joinplan", JoinPlan, _pack_join_plan, _unpack_join_plan)
+# lint: wire-oneside (DKG Part rides inside key-gen contribution
+#     payloads the engine never parses)
 register_struct("part", Part, _pack_part, _unpack_part)
+# lint: wire-oneside (DKG Ack rides inside key-gen contribution
+#     payloads the engine never parses)
 register_struct("ack", Ack, _pack_ack, _unpack_ack)
 
 # transport-boundary (live wire) types

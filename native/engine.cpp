@@ -1859,6 +1859,8 @@ struct Ctx {
   // pool callback: TS._on_verified lifted through the coin-round /
   // epoch / era guards (binary_agreement._coin_scope_wrap +
   // honey_badger._guard_epoch).
+  // mirror: ts-acceptance-item (twin: threshold_sign.handle_message /
+  //     _on_verified — acceptance-rule changes land on BOTH sides)
   void ts_verified_cb(int era, int epoch, int proposer, int rnd,
                       std::shared_ptr<Ts> ts, int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
@@ -1907,6 +1909,8 @@ struct Ctx {
   // has effects — and it runs here with the same state it would have
   // seen per-item.  Fault order within the group is submission order,
   // as in the per-share path.
+  // mirror: ts-acceptance-group (twin: threshold_sign._on_verified —
+  //     acceptance-rule changes land on BOTH continuations)
   void ts_group_verified_cb(int era, int epoch, int proposer, int rnd,
                             const std::shared_ptr<Ts>& ts, Pending& lead) {
     size_t count = lead.grp.size(), vlim = 0;
@@ -3013,6 +3017,8 @@ struct Ctx {
     pool_push(e, node, std::move(p));
   }
 
+  // mirror: td-acceptance-item (twin: threshold_decrypt.handle_message /
+  //     _on_verified — acceptance-rule changes land on BOTH sides)
   void td_verified_cb(int era, int epoch, int proposer, std::shared_ptr<Td> td,
                       int sender, const U256& share,
                       std::shared_ptr<const Bytes> share_b, bool ok) {
@@ -3042,6 +3048,8 @@ struct Ctx {
   // shares — the ThresholdDecrypt twin of ts_group_verified_cb (same
   // no-op argument: pre-termination lifts see an empty plain_out and a
   // valid ciphertext, post-termination items are skipped entirely).
+  // mirror: td-acceptance-group (twin: threshold_decrypt._on_verified —
+  //     acceptance-rule changes land on BOTH continuations)
   void td_group_verified_cb(int era, int epoch, int proposer,
                             const std::shared_ptr<Td>& td, Pending& lead) {
     size_t count = lead.grp.size(), vlim = 0;
@@ -4026,6 +4034,8 @@ uint64_t engine_run(Engine& e, uint64_t max_deliveries) {
 
 // ===========================================================================
 // Wire codec: EMsg <-> the serde wire grammar (ISSUE 5)
+// mirror: wire-grammar (twin: hbbft_tpu/wire.py registration table —
+//     HBX001 diffs the tag sets; add/remove tags on BOTH sides)
 //
 // ENCODE produces the exact bytes Python's serde.dumps would emit for
 // SqMessage.algo(DhbMessage(era, HbMessage(...))) over the wire.py
@@ -4698,6 +4708,8 @@ bool wire_decode(const uint8_t* data, uint64_t len, WireDecoded& out) {
       oneshot.resize(need);
       bp = oneshot.data();
     }
+    // mirror: serde-scan-limits (twin: serde.MAX_DEPTH / serde._MAX_LEN
+    //     — HBX001 pins these literals to the Python constants)
     int64_t rc = hbe_serde_scan(data, len, bp, triples, 64, 1ull << 28);
     if (rc == -2) continue;  // buffer too small: retry exact
     if (rc < 0) return false;
@@ -4711,6 +4723,8 @@ bool wire_decode(const uint8_t* data, uint64_t len, WireDecoded& out) {
 // ---------------------------------------------------------------------------
 
 // SenderQueue._admits: 0 send, 1 hold (ahead of window), 2 drop (stale).
+// mirror: sq-admission (twin: sender_queue.SenderQueue._admits —
+//     window-rule changes land on BOTH sides)
 inline int cluster_admit(const std::array<int64_t, 2>& pe, int64_t era,
                          int64_t epoch, int32_t window) {
   if (era < pe[0]) return 2;

@@ -585,3 +585,69 @@ def test_stall_drill_diag_names_stuck_proposer():
             for e in c.trace_events().get("cluster", [])
         )
         c.reconnect(2)
+
+
+# ---------------------------------------------------------------------------
+# tools/analyze.py CLI error paths (missing / truncated / wrong-shape
+# trace.json, empty tracks).  The happy path has golden-fixture coverage
+# above; these pin that a bad input is a clean exit-2 diagnostic on
+# stderr, never a traceback, and that an event-free dump is an honest
+# empty analysis.
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(capsys, argv):
+    from tools.analyze import main
+
+    rc = main(argv)
+    cap = capsys.readouterr()
+    return rc, cap.out, cap.err
+
+
+def test_cli_missing_trace_file(tmp_path, capsys):
+    rc, out, err = _run_cli(capsys, [str(tmp_path / "nope.json")])
+    assert rc == 2
+    assert "cannot read" in err
+    assert "Traceback" not in err
+
+
+def test_cli_truncated_trace_file(tmp_path, capsys):
+    # A dump cut off mid-write (the realistic failure: a killed worker).
+    p = tmp_path / "trunc.json"
+    good = json.dumps({"traceEvents": [], "otherData": {"t0_unix_s": 1.0}})
+    p.write_text(good[: len(good) // 2])
+    rc, out, err = _run_cli(capsys, [str(p)])
+    assert rc == 2
+    assert "truncated" in err
+
+
+def test_cli_wrong_shape_trace_file(tmp_path, capsys):
+    # Valid JSON, wrong document shape (not a Chrome-trace object).
+    p = tmp_path / "list.json"
+    p.write_text("[1, 2, 3]")
+    rc, out, err = _run_cli(capsys, [str(p)])
+    assert rc == 2
+    assert "not a Chrome-trace document" in err
+
+
+def test_cli_empty_tracks(tmp_path, capsys):
+    # A dump taken before any epoch opened: zero events is an honest
+    # empty analysis (exit 0), flagged on stderr, valid --json output.
+    p = tmp_path / "empty.json"
+    p.write_text(json.dumps({"traceEvents": [], "otherData": {"t0_unix_s": 0}}))
+    rc, out, err = _run_cli(capsys, [str(p), "--json"])
+    assert rc == 0
+    assert "empty tracks" in err
+    doc = json.loads(out)
+    assert doc["critical_path"] == []
+    assert doc["summary"] == {"epochs": 0}
+
+
+def test_cli_empty_tracks_diag(tmp_path, capsys):
+    p = tmp_path / "empty.json"
+    p.write_text(json.dumps({"traceEvents": [], "otherData": {"t0_unix_s": 0}}))
+    rc, out, err = _run_cli(capsys, [str(p), "--json", "--diag"])
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["diag"]["stalled"] is False
+    assert doc["diag"]["open_epochs"] == {}

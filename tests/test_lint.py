@@ -7,14 +7,18 @@ is pinned without touching disk; paths are virtual but repo-shaped
 (several rules scope by path).
 """
 
+import json
+import os
 import subprocess
 import sys
 
 import pytest
 
-from tools.lint import run_all
+from tools.lint import contracts, knob_registry, run_all
 from tools.lint.cxxlints import lint_source
 from tools.lint.pylints import lint_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def rules_of(findings):
@@ -633,6 +637,217 @@ def test_trace_enum_name_mapping_rule():
 
 
 # ---------------------------------------------------------------------------
+# HBX001-003: cross-language contracts (tools/lint/contracts.py).
+# Mutation self-tests: seed a one-line drift into a string copy of the
+# real sources (via the overrides dict — disk is never touched) and
+# assert the rule fires, so the analyzer is provably live, not
+# vacuously green.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wire_src():
+    with open(os.path.join(REPO, "hbbft_tpu", "wire.py")) as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def engine_src():
+    with open(os.path.join(REPO, "native", "engine.cpp")) as f:
+        return f.read()
+
+
+def test_hbx001_clean_at_head():
+    assert contracts.rule_wire_parity() == []
+
+
+def test_hbx001_engine_tag_rename_fires(engine_src):
+    # One-line drift: the engine starts emitting/accepting a tag the
+    # Python codec has never heard of (and stops carrying ba_aux).
+    mutated = engine_src.replace('"ba_aux"', '"ba_zux"')
+    assert mutated != engine_src
+    found = contracts.rule_wire_parity({"native/engine.cpp": mutated})
+    assert any(f.rule == "HBX001" and "ba_zux" in f.message for f in found)
+    # ...and the now-orphaned Python registration is flagged too.
+    assert any(
+        f.rule == "HBX001" and '"ba_aux"' in f.message and f.path.endswith("wire.py")
+        for f in found
+    )
+
+
+def test_hbx001_python_registration_removed_fires(wire_src):
+    lines = [
+        ln
+        for ln in wire_src.splitlines(keepends=True)
+        if 'register_struct("ba_aux"' not in ln
+    ]
+    mutated = "".join(lines)
+    assert mutated != wire_src
+    found = contracts.rule_wire_parity({"hbbft_tpu/wire.py": mutated})
+    assert any(
+        f.rule == "HBX001"
+        and f.path == "native/engine.cpp"
+        and '"ba_aux"' in f.message
+        for f in found
+    )
+
+
+def test_hbx001_oneside_annotation_removed_fires(wire_src):
+    # Drop just the marker line above the "ct" registration: the tag is
+    # still legitimately Python-only, but the explicit escape is gone.
+    lines = [
+        ln
+        for ln in wire_src.splitlines(keepends=True)
+        if "wire-oneside (engine carries ciphertexts" not in ln
+    ]
+    mutated = "".join(lines)
+    assert mutated != wire_src
+    found = contracts.rule_wire_parity({"hbbft_tpu/wire.py": mutated})
+    assert any(
+        f.rule == "HBX001" and '"ct"' in f.message and "wire-oneside" in f.message
+        for f in found
+    )
+
+
+def test_hbx001_stale_oneside_annotation_fires(wire_src):
+    # An escape on a tag the engine DOES mirror is itself a finding.
+    mutated = wire_src.replace(
+        'register_struct("sqmsg"',
+        '# lint: wire-oneside (bogus escape)\nregister_struct("sqmsg"',
+    )
+    assert mutated != wire_src
+    found = contracts.rule_wire_parity({"hbbft_tpu/wire.py": mutated})
+    assert any(
+        f.rule == "HBX001" and "stale escape" in f.message and '"sqmsg"' in f.message
+        for f in found
+    )
+
+
+def test_hbx001_scan_limit_drift_fires(engine_src):
+    mutated = engine_src.replace("1ull << 28", "1ull << 20")
+    assert mutated != engine_src
+    found = contracts.rule_wire_parity({"native/engine.cpp": mutated})
+    assert any(f.rule == "HBX001" and "max_len" in f.message for f in found)
+
+
+def test_hbx001_depth_limit_drift_fires(engine_src):
+    mutated = engine_src.replace("bp, triples, 64,", "bp, triples, 63,")
+    assert mutated != engine_src
+    found = contracts.rule_wire_parity({"native/engine.cpp": mutated})
+    assert any(f.rule == "HBX001" and "max_depth" in f.message for f in found)
+
+
+def test_hbx001_extraction_failure_is_loud():
+    # A refactor that renames the extraction landmarks must fail the
+    # lint, never silently disable the rule.
+    found = contracts.rule_wire_parity({"native/engine.cpp": "int main() {}\n"})
+    assert any(
+        f.rule == "HBX001" and "extraction failed" in f.message for f in found
+    )
+
+
+def test_hbx002_clean_at_head():
+    assert contracts.rule_knob_registry() == []
+
+
+def test_hbx002_unregistered_knob_fires():
+    # The fixture file's AST joins the adjacent literals into one knob
+    # name; this test file itself never contains it contiguously (the
+    # scan excludes tests/test_lint.py anyway).
+    sneaky = "HBBFT_TPU_" + "SNEAKY"
+    fixture = 'import os\nX = os.environ.get("HBBFT_TPU_" "SNEAKY", "0")\n'
+    found = contracts.rule_knob_registry({"hbbft_tpu/zz_knob_fixture.py": fixture})
+    assert any(
+        f.rule == "HBX002"
+        and sneaky in f.message
+        and f.path == "hbbft_tpu/zz_knob_fixture.py"
+        for f in found
+    )
+
+
+def test_hbx002_unregistered_c_knob_fires():
+    ghost = "HBBFT_TPU_" + "CGHOST"
+    fixture = '#include <cstdlib>\nstatic int g = !!getenv("' + ghost + '");\n'
+    found = contracts.rule_knob_registry({"native/zz_fixture.cpp": fixture})
+    assert any(f.rule == "HBX002" and ghost in f.message for f in found)
+
+
+def test_hbx002_dead_registry_entry_fires(monkeypatch):
+    ghost = "HBBFT_TPU_" + "GHOST"
+    patched = dict(knob_registry.KNOBS)
+    patched[ghost] = knob_registry.Knob(ghost, "unset", "nowhere", "dead entry")
+    monkeypatch.setattr(knob_registry, "KNOBS", patched)
+    found = contracts.rule_knob_registry()
+    assert any(
+        f.rule == "HBX002" and ghost in f.message and "no os.environ" in f.message
+        for f in found
+    )
+    # The committed KNOBS.md no longer matches the (patched) registry
+    # either — staleness is part of the same contract.
+    assert any(f.rule == "HBX002" and f.path == "docs/KNOBS.md" for f in found)
+
+
+def test_hbx002_stale_knobs_md_fires():
+    found = contracts.rule_knob_registry({"docs/KNOBS.md": "# stale\n"})
+    assert any(
+        f.rule == "HBX002"
+        and f.path == "docs/KNOBS.md"
+        and "--knobs-md" in f.message
+        for f in found
+    )
+
+
+def test_hbx002_committed_knobs_md_matches_generated():
+    with open(os.path.join(REPO, "docs", "KNOBS.md")) as f:
+        committed = f.read()
+    assert committed.rstrip("\n") == knob_registry.generate_knobs_md().rstrip("\n")
+
+
+def test_hbx003_clean_at_head():
+    assert contracts.rule_mirror_obligations() == []
+
+
+def test_hbx003_orphan_python_anchor_fires():
+    fixture = "# mirror: only-here-key — fixture orphan\n"
+    found = contracts.rule_mirror_obligations(
+        {"hbbft_tpu/zz_mirror_fixture.py": fixture}
+    )
+    assert any(
+        f.rule == "HBX003"
+        and "only-here-key" in f.message
+        and "no C++ twin" in f.message
+        for f in found
+    )
+
+
+def test_hbx003_deleted_cxx_anchor_fires(engine_src):
+    # Deleting one half of a mirrored pair (here: the engine's
+    # ts-acceptance-item anchor) must point at the surviving twin.
+    mutated = engine_src.replace("// mirror: ts-acceptance-item", "//")
+    assert mutated != engine_src
+    found = contracts.rule_mirror_obligations({"native/engine.cpp": mutated})
+    orphans = [
+        f for f in found if f.rule == "HBX003" and "ts-acceptance-item" in f.message
+    ]
+    assert orphans and orphans[0].path == "hbbft_tpu/protocols/threshold_sign.py"
+
+
+def test_hbx003_deleted_python_anchor_fires():
+    rel = "hbbft_tpu/protocols/threshold_decrypt.py"
+    with open(os.path.join(REPO, rel)) as f:
+        src = f.read()
+    mutated = src.replace("# mirror: td-acceptance-group", "#")
+    assert mutated != src
+    found = contracts.rule_mirror_obligations({rel: mutated})
+    orphans = [
+        f
+        for f in found
+        if f.rule == "HBX003" and "td-acceptance-group" in f.message
+    ]
+    assert orphans and orphans[0].path == "native/engine.cpp"
+
+
+# ---------------------------------------------------------------------------
 # Whole-repo gates
 # ---------------------------------------------------------------------------
 
@@ -666,3 +881,52 @@ def test_cli_exit_codes(tmp_path):
         env=env,
     )
     assert res.returncode == 1, res.stdout + res.stderr
+
+
+def test_cli_json_mode(tmp_path):
+    env = {**os.environ, "PYTHONPATH": REPO}
+    # A violating fixture under --json: exit 1, every stdout line is one
+    # JSON object with the (rule, file, line, message) schema; status
+    # chatter stays on stderr.
+    target = tmp_path / "hbbft_tpu" / "crypto" / "tpu"
+    target.mkdir(parents=True)
+    (target / "fixture.py").write_text(HBT001_BAD)
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json", str(target / "fixture.py")],
+        capture_output=True,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    lines = [ln for ln in res.stdout.splitlines() if ln.strip()]
+    assert lines
+    for ln in lines:
+        obj = json.loads(ln)
+        assert set(obj) == {"rule", "file", "line", "message"}
+        assert isinstance(obj["line"], int)
+    assert any(json.loads(ln)["rule"] == "HBT001" for ln in lines)
+    # Clean whole-repo run under --json: exit 0, empty stdout.
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json"],
+        capture_output=True,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert ok.stdout.strip() == ""
+
+
+def test_cli_knobs_md_matches_committed():
+    env = {**os.environ, "PYTHONPATH": REPO}
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--knobs-md"],
+        capture_output=True,
+        cwd=REPO,
+        env=env,
+        text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    with open(os.path.join(REPO, "docs", "KNOBS.md")) as f:
+        assert res.stdout.rstrip("\n") == f.read().rstrip("\n")

@@ -9,8 +9,15 @@ here.  Everything is seeded — a failing example replays exactly.
 
 import random
 
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+# hypothesis is an optional [test] extra (pyproject.toml) — absent on
+# minimal boxes; skip at collection instead of erroring so the tier-1
+# run doesn't need --continue-on-collection-errors to survive.
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from hbbft_tpu.net import (
     NetBuilder,

@@ -221,13 +221,39 @@ def main(argv: List[str] | None = None) -> int:
             ).read()
         )
     elif args.trace:
-        with open(args.trace) as fh:
-            doc = json.load(fh)
+        try:
+            with open(args.trace) as fh:
+                doc = json.load(fh)
+        except OSError as exc:
+            print(f"analyze: cannot read {args.trace}: {exc}", file=sys.stderr)
+            return 2
+        except json.JSONDecodeError as exc:
+            print(
+                f"analyze: {args.trace} is not a complete JSON document"
+                f" (truncated dump?): {exc}",
+                file=sys.stderr,
+            )
+            return 2
     else:
         ap.error("need a trace.json path, --url, or --demo N")
         return 2
 
-    tracks = tracks_from_chrome(doc)
+    try:
+        tracks = tracks_from_chrome(doc)
+    except (AttributeError, KeyError, TypeError, ValueError) as exc:
+        print(
+            f"analyze: not a Chrome-trace document ({exc!r}) — expected "
+            "the recorder's trace.json shape (traceEvents + otherData)",
+            file=sys.stderr,
+        )
+        return 2
+    if not tracks:
+        # Valid document, zero recorder events (e.g. a dump taken before
+        # any epoch opened): an honest empty analysis, not a crash.
+        print(
+            "analyze: trace contains no recorder events (empty tracks)",
+            file=sys.stderr,
+        )
     records = critical_path(tracks)
     out: Dict[str, Any] = {
         "critical_path": records,

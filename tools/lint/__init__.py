@@ -95,7 +95,7 @@ def run_all(paths: List[str] | None = None) -> List[Finding]:
     """Lint the repo (or just ``paths``); returns all findings.
     Explicit paths are expanded via :func:`expand_paths` (files no rule
     applies to are dropped — CLI callers surface those as skipped)."""
-    from tools.lint import cxxlints, pylints
+    from tools.lint import contracts, cxxlints, pylints
 
     findings: List[Finding] = []
     if paths:
@@ -115,4 +115,9 @@ def run_all(paths: List[str] | None = None) -> List[Finding]:
         engine = os.path.join(_REPO, "native", "engine.cpp")
         with open(engine, "r", encoding="utf-8") as f:
             findings.extend(cxxlints.lint_source(f.read(), "native/engine.cpp"))
+        # Cross-language contract rules (HBX0xx) read a fixed repo-level
+        # file set (wire.py <-> engine.cpp, the knob registry, mirror
+        # anchors), so they only make sense for whole-repo runs —
+        # explicit-path invocations skip them.
+        findings.extend(contracts.lint_contracts())
     return sorted(findings, key=lambda x: (x.path, x.line, x.rule))

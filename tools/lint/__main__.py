@@ -1,28 +1,45 @@
-"""CLI: ``python -m tools.lint [paths...]``.
+"""CLI: ``python -m tools.lint [--json] [--knobs-md] [paths...]``.
 
 No arguments lints the default surface (hbbft_tpu/**/*.py +
-native/engine.cpp).  Explicit paths lint just those files (rules still
-scope by path, so fixture files must carry repo-shaped names); files no
+native/engine.cpp + the repo-level HBX contract rules).  Explicit paths
+lint just those files (rules still scope by path, so fixture files must
+carry repo-shaped names; the repo-level HBX rules are skipped); files no
 rule applies to are reported as skipped, never silently blessed.  Exit
 status 1 iff findings exist.
+
+``--json`` emits one JSON object per finding per line
+(``{"rule", "file", "line", "message"}``) on stdout — status chatter
+stays on stderr, so CI can consume stdout without parsing human text.
+``--knobs-md`` prints the generated docs/KNOBS.md content and exits
+(``python -m tools.lint --knobs-md > docs/KNOBS.md`` is the regen
+recipe HBX002 hints at).
 """
 
 from __future__ import annotations
 
+import json
 import sys
 
 from tools.lint import expand_paths, run_all
 
 
 def main(argv: list[str]) -> int:
+    as_json = "--json" in argv
+    knobs_md = "--knobs-md" in argv
+    argv = [a for a in argv if a not in ("--json", "--knobs-md")]
     flags = [a for a in argv if a.startswith("-")]
     if flags:
         print(
             f"tools.lint: unknown option(s) {flags} (usage:"
-            " python -m tools.lint [paths...])",
+            " python -m tools.lint [--json] [--knobs-md] [paths...])",
             file=sys.stderr,
         )
         return 2
+    if knobs_md:
+        from tools.lint.knob_registry import generate_knobs_md
+
+        sys.stdout.write(generate_knobs_md() + "\n")
+        return 0
     if argv:
         files, skipped = expand_paths(argv)
         for p, reason in skipped:
@@ -38,7 +55,19 @@ def main(argv: list[str]) -> int:
             return 2
     findings = run_all(argv or None)
     for f in findings:
-        print(f.render())
+        if as_json:
+            print(
+                json.dumps(
+                    {
+                        "rule": f.rule,
+                        "file": f.path,
+                        "line": f.line,
+                        "message": f.message,
+                    }
+                )
+            )
+        else:
+            print(f.render())
     if findings:
         print(f"{len(findings)} finding(s)", file=sys.stderr)
         return 1
