@@ -86,5 +86,14 @@ cryptoplane-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cryptoplane.py \
 		-q -m 'not slow'
 
+# Engine-plane tier (ISSUE 14 + 17): the vectorized field plane (kernel
+# fuzz + cross-arm identity) and the epoch arena + batched sha3 plane
+# (hashlib-oracle fuzz both arms, ARENA x SIMD identity matrix over an
+# era change, telemetry sanity).  No jax/XLA involvement — safe during
+# crypto-cache cold states; skips cleanly without g++.
+engine-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_field_simd.py \
+		tests/test_sha3_arena.py -q -m 'not slow'
+
 .PHONY: lint check asan ubsan tsan test-protocol cluster-smoke traffic-smoke \
-	chaos-smoke obs-smoke cryptoplane-smoke diag
+	chaos-smoke obs-smoke cryptoplane-smoke engine-smoke diag

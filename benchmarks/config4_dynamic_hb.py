@@ -147,22 +147,28 @@ def main_native() -> None:
     if os.environ.get("BENCH_PROF"):
         # Era-change split in Gcyc (hbe_prof_cycles — the A/B currency
         # per the clock-drift rule in CLAUDE.md), slots per
-        # tools/lint/slot_registry.py: 11 = RLC group stats, 12/15 =
-        # Python batch_cb / contrib_cb wall (the round-6 batch-digest
-        # split), 13 = epoch-advance wall, 14 = the SIMD combine-kernel
-        # wall (round 15; the old round-4 continuation-split names died
-        # with their slots — don't compare against round-4/5 numbers).
+        # tools/lint/slot_registry.py: 11 = RLC group stats, 12 = Python
+        # batch_cb wall (the round-6 batch-digest split; its slot-15
+        # contrib_cb partner retired in round 17), 13 = epoch-advance
+        # wall, 14 = the SIMD combine-kernel wall (round 15; the old
+        # round-4 continuation-split names died with their slots —
+        # don't compare against round-4/5 numbers).  Slot 15 is the
+        # arena stats now (cycles = max per-node high-water mark BYTES,
+        # not cycles) — exported via arena_stats()/sha3_stats below,
+        # not the Gcyc loop.
         lib, h = nat.lib, nat.handle
         prof = {}
         for slot, name in (
             (14, "combine_kernel"), (13, "epoch_advance"), (11, "rlc_groups"),
-            (12, "batch_cb"), (15, "contrib_cb"),
+            (12, "batch_cb"),
         ):
             prof[name + "_gcyc"] = round(
                 int(lib.hbe_prof_cycles(h, slot)) / 1e9, 3
             )
             prof[name + "_n"] = int(lib.hbe_prof_count(h, slot))
         record["prof"] = prof
+        record["arena"] = nat.arena_stats()
+        record["sha3"] = nat.sha3_stats()
         record["dkg_batch"] = os.environ.get("HBBFT_TPU_DKG_BATCH", "1")
     print(json.dumps(record))
 

@@ -103,7 +103,9 @@ def _engine_build_fields(n: int) -> dict:
     (native nodes — in-process or proc-mode workers, which run the same
     loader — pick the -DHBE_WORDS build via _words_for), not the
     default build.  Empty when no engine lib loads (pure-Python arms
-    still decode via it when present)."""
+    still decode via it when present).  Round 17 adds the epoch-arena
+    recycle knob (mirrors the engine's hbe_create env read — workers
+    inherit the environment, so this names the arm for proc mode too)."""
     try:
         from hbbft_tpu import native_engine
 
@@ -113,7 +115,26 @@ def _engine_build_fields(n: int) -> dict:
         return {
             "simd": native_engine.simd_mode(lib),
             "hbe_words": int(lib.hbe_words()),
+            "arena_recycle": os.environ.get("HBBFT_TPU_ARENA", "1") != "0",
         }
+    except Exception:
+        return {}
+
+
+def _sha3_plane_fields(n: int) -> dict:
+    """Post-run sha3-plane counters (round 17).  Library-global since
+    process start, so only the in-process (thread-mode) arms stamp
+    them — the proc-mode parent never hashes, its counters would read
+    ~0 while the workers did the work.  One cluster per benchmark
+    process keeps them per-run in practice."""
+    try:
+        from hbbft_tpu import native_engine
+
+        lib = native_engine.get_lib(native_engine._words_for(n))
+        if lib is None:
+            return {}
+        st = native_engine.sha3_plane_stats(lib)
+        return {"sha3": st} if st else {}
     except Exception:
         return {}
 
@@ -356,6 +377,11 @@ def run_n(
         if os.environ.get("BENCH_TCP_METRICS"):
             rec["metrics"] = m.to_json()
         obs_extras(rec, cluster, f"config6_n{n}_{impl}", m=m)
+        # Arena high-water marks ride the merged metrics already
+        # (engine.cyc.arena via the slot-15 counter sync); the sha3
+        # plane is library-global, so stamp it post-run here (thread
+        # arms only — see _sha3_plane_fields).
+        rec.update(_sha3_plane_fields(n))
     finally:
         cluster.stop()
     return rec

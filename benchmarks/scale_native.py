@@ -39,6 +39,12 @@ back-to-back on a quiet box, compare COIN/DECRYPT cyc/delivery and
 ``combine_kernel`` cycles/count, and control-correct with the untouched
 BVAL slot.
 
+Round 17: the line also carries the epoch-arena stats (``arena``:
+high-water marks / resets / recycle knob) and the batched sha3-plane
+counters (``sha3``), making the HBBFT_TPU_ARENA=0/1 recycling A/B two
+self-describing runs; the slot-13 ``epoch_advance`` cyc/count is that
+A/B's primary readout.
+
 Env: SCALE_NS (comma list, default "300,512"), SCALE_BUDGET_S per N
 (default 5400), SCALE_WINDOW (rate-window deliveries, default 30M),
 SCALE_FLUSH_EVERY (RLC arm only; default 5000).
@@ -143,6 +149,15 @@ def run_n(n: int, budget_s: float, window: int) -> dict:
     # direct readout for the HBBFT_TPU_SIMD A/B — cycles/combine on the
     # Lagrange-coefficients + combine-sum kernel.
     rec["combine_kernel"] = prof["combine_kernel"]
+    # Epoch-arena + sha3-plane self-description (round 17): per-node
+    # high-water marks / reset count / recycle knob for the
+    # HBBFT_TPU_ARENA A/B, and the batched-hash counters (ifma_msgs > 0
+    # iff the 8-lane arm actually ran).  sha3 counters are library-
+    # global since process start — treat them as per-run only when one
+    # engine ran in the process (true here).
+    rec["arena"] = nat.arena_stats()
+    rec["sha3"] = nat.sha3_stats()
+    rec["epoch_advance"] = prof["epoch_advance"]
     if os.environ.get("SCALE_METRICS"):
         # Metrics-framework snapshot (counters/gauges; same shape the
         # TCP transport exports) — SCALE_METRICS=prom dumps Prometheus

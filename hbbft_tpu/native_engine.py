@@ -181,15 +181,19 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
         # The vectorized field plane (ISSUE 14): field_ifma.cpp is the
         # only unit compiled with -mavx512ifma (dropped automatically on
         # toolchains without it — the stub arm compiles instead, and the
-        # runtime dispatch keeps scalar); field_plane.h is a header dep
-        # of engine.cpp, so edits rebuild every width.
+        # runtime dispatch keeps scalar); field_plane.h / sha3_plane.h
+        # (ISSUE 17) are header deps of engine.cpp, so edits rebuild
+        # every width.
         native_dir = os.path.dirname(_SRC)
         lib = build_and_load(
             _SRC, _SO_TMPL.format(w=words),
             extra_flags=(f"-DHBE_WORDS={words}",),
             aux_sources=(os.path.join(native_dir, "field_ifma.cpp"),),
             aux_flags=("-mavx512ifma",),
-            extra_deps=(os.path.join(native_dir, "field_plane.h"),),
+            extra_deps=(
+                os.path.join(native_dir, "field_plane.h"),
+                os.path.join(native_dir, "sha3_plane.h"),
+            ),
         )
     if lib is None:
         return None
@@ -385,6 +389,22 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_field_lagrange.argtypes = [i32p, ctypes.c_int32, u8p]
     lib.hbe_field_rlc_accum.restype = None
     lib.hbe_field_rlc_accum.argtypes = [cp, cp, ctypes.c_int32, u8p]
+    # Batched sha3 plane + epoch arena (ISSUE 17): the sha3 fuzz/stats
+    # surface and the arena high-water-mark telemetry.  Guarded: pre-17
+    # engine snapshots loaded via HBBFT_TPU_ENGINE_LIB for vs-seed A/Bs
+    # lack these symbols — stats callers degrade to {} (arena_stats /
+    # sha3_plane_stats), everything else is unaffected.
+    if hasattr(lib, "hbe_sha3_batch"):
+        lib.hbe_sha3_batch.restype = None
+        lib.hbe_sha3_batch.argtypes = [
+            cp, ctypes.c_uint64, ctypes.c_uint64, u8p,
+        ]
+        lib.hbe_sha3_stats.restype = None
+        lib.hbe_sha3_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+        lib.hbe_arena_stats.restype = None
+        lib.hbe_arena_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ]
     lib.hbe_flush.restype = None
     lib.hbe_flush.argtypes = [ctypes.c_void_p]
     lib.hbe_ret_bytes.restype = None
@@ -428,6 +448,25 @@ def simd_mode(lib: Optional[ctypes.CDLL] = None) -> str:
     if lib is None:
         return "unavailable"
     return "ifma" if lib.hbe_simd_mode() else "scalar"
+
+
+def sha3_plane_stats(lib: Optional[ctypes.CDLL] = None) -> Dict[str, int]:
+    """Batched sha3-plane counters since process start (library-global,
+    ISSUE 17): batch calls/messages, messages hashed by the 8-lane IFMA
+    arm, and single-message (``sha3_256_one``) calls.  Module-level so
+    benchmarks without a net handle (config6 clusters) can stamp them;
+    per-run only when one engine build hashed in this process."""
+    lib = lib if lib is not None else get_lib()
+    if lib is None or not hasattr(lib, "hbe_sha3_stats"):
+        return {}
+    buf = (ctypes.c_uint64 * 4)()
+    lib.hbe_sha3_stats(buf)
+    return {
+        "batch_calls": int(buf[0]),
+        "batch_msgs": int(buf[1]),
+        "ifma_msgs": int(buf[2]),
+        "single_msgs": int(buf[3]),
+    }
 
 
 _SCHED_KINDS = {"always": 0, "never": 1, "every_nth": 2, "tick_tock": 3}
@@ -732,13 +771,39 @@ class _EngineNetBase:
             (12, "batch_cb"),
             (13, "epoch_advance"),
             (14, "combine_kernel"),  # round 15: the SIMD combine wall
-            (15, "contrib_cb"),
+            # Round 17: slot 15 retired its round-6 contrib_cb stamp for
+            # the epoch-arena stats (cycles = max per-node high-water
+            # mark in BYTES, count = watermark resets).
+            (15, "arena"),
         ):
             out[name] = {
                 "cycles": int(lib.hbe_prof_cycles(h, slot)),
                 "count": int(lib.hbe_prof_count(h, slot)),
             }
         return out
+
+    def arena_stats(self) -> Dict[str, int]:
+        """Epoch-arena telemetry (ISSUE 17): max/sum of the per-node
+        high-water marks (bytes carved per epoch), total watermark
+        resets, and the recycle knob (``HBBFT_TPU_ARENA``; 0 = the
+        free-every-epoch A/B arm).  Empty on pre-17 engine snapshots
+        (vs-seed A/B arms)."""
+        if not hasattr(self.lib, "hbe_arena_stats"):
+            return {}
+        buf = (ctypes.c_uint64 * 4)()
+        self.lib.hbe_arena_stats(self.handle, buf)
+        return {
+            "hwm_max": int(buf[0]),
+            "hwm_sum": int(buf[1]),
+            "resets": int(buf[2]),
+            "recycle": int(buf[3]),
+        }
+
+    def sha3_stats(self) -> Dict[str, int]:
+        """Batched sha3-plane counters since process start (library-
+        global, ISSUE 17): batch calls/messages, messages hashed by the
+        8-lane IFMA arm, and single-message (``sha3_256_one``) calls."""
+        return sha3_plane_stats(self.lib)
 
     # Engine TraceKind values (native/engine.cpp enum TraceKind) -> the
     # shared milestone taxonomy (docs/OBSERVABILITY.md).  d packs

@@ -27,6 +27,7 @@
 #include <algorithm>
 #include <cassert>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <functional>
@@ -36,10 +37,12 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <type_traits>
 #include <vector>
 
 #include "field_plane.h"
 #include "sha3_gf.h"
+#include "sha3_plane.h"
 #include <chrono>
 
 namespace {
@@ -244,10 +247,12 @@ inline Bytes canon3(const Bytes& a, const Bytes& b, const Bytes& c) {
 }
 
 // ScalarSuite.hash_to_g2: sha3(canonical(b"h2g2", data)) % (r-1) + 1.
+// One (often very long — the DKG ciphertext digest) message: the sha3
+// plane's single-message path, counted but never lane-parallel.
 inline U256 hash_to_g2(const Bytes& data) {
   Bytes buf = canon2("h2g2", data);
   uint8_t digest[32];
-  hbn::sha3_256((const uint8_t*)buf.data(), buf.size(), digest);
+  hbs::sha3_256_one((const uint8_t*)buf.data(), buf.size(), digest);
   U256 v = u256_from_be(digest, 32);
   // v mod (r-1): v < 2^256 < 3(r-1), so at most two subtractions.
   while (u256_cmp(v, R_MINUS_1) >= 0) {
@@ -266,21 +271,26 @@ inline bool sig_parity(const U256& sig) {
   return digest[0] & 1;
 }
 
-// kdf_stream(seed, n): sha3(seed || ctr 8B BE) blocks.
+// kdf_stream(seed, n): sha3(seed || ctr 8B BE) blocks.  The blocks are
+// independent equal-length messages, so the whole stream is one sha3
+// plane batch: the counter messages are staged contiguously and the
+// digests land directly in the output layout (32 bytes per block).
+// Stream bytes are identical to the old per-block loop — same messages,
+// same digests, same order.
 inline Bytes kdf_stream(const Bytes& seed, size_t n) {
-  Bytes out;
-  out.reserve(n + 32);
-  uint64_t ctr = 0;
-  while (out.size() < n) {
-    Bytes block = seed;
-    uint8_t c8[8];
-    for (int i = 0; i < 8; ++i) c8[i] = (uint8_t)(ctr >> (56 - 8 * i));
-    block.append((const char*)c8, 8);
-    uint8_t digest[32];
-    hbn::sha3_256((const uint8_t*)block.data(), block.size(), digest);
-    out.append((const char*)digest, 32);
-    ++ctr;
+  size_t nblocks = (n + 31) / 32;
+  if (!nblocks) return Bytes();
+  size_t msg_len = seed.size() + 8;
+  std::vector<uint8_t> stage(nblocks * msg_len);
+  for (size_t ctr = 0; ctr < nblocks; ++ctr) {
+    uint8_t* m = stage.data() + ctr * msg_len;
+    std::memcpy(m, seed.data(), seed.size());
+    for (int i = 0; i < 8; ++i)
+      m[seed.size() + i] = (uint8_t)((uint64_t)ctr >> (56 - 8 * i));
   }
+  Bytes out;
+  out.resize(nblocks * 32);
+  hbs::sha3_256_batch(stage.data(), msg_len, nblocks, (uint8_t*)&out[0]);
   out.resize(n);
   return out;
 }
@@ -599,6 +609,192 @@ inline std::vector<int> str_sorted(std::vector<int> ids) {
 }
 
 // ===========================================================================
+// Epoch-state arena (ISSUE 17)
+//
+// Per-epoch protocol state used to live in std::maps (echo/ready/share
+// maps, future-message counters): at N=300 the slot-13 epoch-advance
+// stamp measured ~20 Gcyc/epoch of rb-tree teardown + reallocation, and
+// the delivery envelope at big N is dominated by dependent cache misses
+// chasing freshly allocated rb-tree nodes.  Every one of those maps is
+// keyed by an engine node id in [0, e.n) — so they become flat,
+// index-keyed arrays (FlatMap) carved from a per-NODE bump arena that
+// is recycled WHOLESALE at epoch advance: reset_for_epoch becomes a
+// watermark reset instead of an exhaustive per-container destructor
+// walk, and a whole epoch's lookups walk a handful of contiguous,
+// epoch-hot blocks.
+//
+// Identity argument (docs/INVARIANTS.md "epoch-state arena"): a
+// std::map<int, T> with keys restricted to [0, n) iterates in ascending
+// key order; a FlatMap iterates present indices 0..n-1 ascending — the
+// same sequence — and find/insert semantics are one-to-one, so every
+// converted container preserves the Python dict/Counter iteration
+// behavior the maps encoded.  HBBFT_TPU_ARENA=0 (read at hbe_create)
+// keeps the same flat containers but FREES the blocks at every reset
+// instead of recycling them — a one-build A/B arm for the recycling
+// itself, byte-identical by construction.
+//
+// Lifetime rule: arena memory lives exactly one epoch.  Anything that
+// can outlive the epoch (Ts/Td continuations in Pending, batch
+// payloads, ProofData pinned by shared_ptr) stays on the normal heap;
+// FlatMap may only hold trivially-destructible values.  Under ASan the
+// recycled blocks are poisoned between epochs, so any cross-epoch read
+// through a stale pointer is a hard fault, not silent state bleed.
+// ===========================================================================
+
+#if defined(__SANITIZE_ADDRESS__)
+#define HBE_ARENA_ASAN 1
+#elif defined(__has_feature)
+#if __has_feature(address_sanitizer)
+#define HBE_ARENA_ASAN 1
+#endif
+#endif
+#ifdef HBE_ARENA_ASAN
+#include <sanitizer/asan_interface.h>
+#define HBE_ARENA_POISON(p, s) ASAN_POISON_MEMORY_REGION((p), (s))
+#define HBE_ARENA_UNPOISON(p, s) ASAN_UNPOISON_MEMORY_REGION((p), (s))
+#else
+#define HBE_ARENA_POISON(p, s) ((void)0)
+#define HBE_ARENA_UNPOISON(p, s) ((void)0)
+#endif
+
+struct EpochArena {
+  struct Block {
+    uint8_t* p;
+    size_t cap;
+  };
+  static const size_t BLOCK = 64 * 1024;
+  std::vector<Block> blocks;
+  size_t cur = 0;        // active block index
+  size_t off = 0;        // bump offset within the active block
+  size_t used = 0;       // bytes handed out since the last reset
+  size_t hwm = 0;        // max `used` over all epochs (hbe_arena_stats)
+  uint64_t resets = 0;
+
+  EpochArena() = default;
+  EpochArena(const EpochArena&) = delete;
+  EpochArena& operator=(const EpochArena&) = delete;
+  EpochArena(EpochArena&& o) noexcept { *this = std::move(o); }
+  EpochArena& operator=(EpochArena&& o) noexcept {
+    release();
+    blocks = std::move(o.blocks);
+    cur = o.cur;
+    off = o.off;
+    used = o.used;
+    hwm = o.hwm;
+    resets = o.resets;
+    o.blocks.clear();
+    o.cur = o.off = o.used = 0;
+    return *this;
+  }
+  ~EpochArena() { release(); }
+
+  uint8_t* alloc(size_t sz) {
+    sz = (sz + 15) & ~(size_t)15;
+    while (true) {
+      if (cur < blocks.size()) {
+        Block& b = blocks[cur];
+        if (off + sz <= b.cap) {
+          uint8_t* p = b.p + off;
+          off += sz;
+          used += sz;
+          HBE_ARENA_UNPOISON(p, sz);
+          return p;
+        }
+        // Advance past this block (its tail stays unused this epoch;
+        // `used` counts handed-out bytes, so the watermark is honest).
+        ++cur;
+        off = 0;
+        continue;
+      }
+      size_t cap = sz > BLOCK ? sz : BLOCK;
+      blocks.push_back({(uint8_t*)::malloc(cap), cap});
+    }
+  }
+
+  // Epoch boundary: one watermark reset.  recycle=1 keeps the blocks
+  // (poisoned under ASan); recycle=0 is the HBBFT_TPU_ARENA=0 A/B arm
+  // (same containers, malloc-fresh blocks every epoch).
+  void reset(bool recycle) {
+    ++resets;
+    if (used > hwm) hwm = used;
+    if (recycle) {
+      for (Block& b : blocks) HBE_ARENA_POISON(b.p, b.cap);
+    } else {
+      release();
+    }
+    cur = 0;
+    off = 0;
+    used = 0;
+  }
+
+  void release() {
+    for (Block& b : blocks) {
+      HBE_ARENA_UNPOISON(b.p, b.cap);
+      ::free(b.p);
+    }
+    blocks.clear();
+  }
+};
+
+// Flat replacement for the per-epoch std::map<int, T> (keys are engine
+// node ids in [0, n)): a value array + presence bitmap carved lazily
+// from the epoch arena on first insert.  Ascending-index iteration ==
+// the map's ascending-key iteration (see the arena identity argument).
+// Values must be trivially destructible — the arena reset never runs
+// destructors (shared_ptr ownership lives elsewhere, e.g. the per-node
+// epoch_pins vector for ProofData).
+template <typename T>
+struct FlatMap {
+  static_assert(std::is_trivially_destructible<T>::value,
+                "arena-backed: reset runs no destructors");
+  T* v = nullptr;
+  uint64_t* present = nullptr;
+  int32_t cap = 0;
+  int32_t count = 0;
+
+  bool has(int k) const {
+    return v && ((present[(unsigned)k >> 6] >> ((unsigned)k & 63)) & 1);
+  }
+  T* find(int k) { return has(k) ? v + k : nullptr; }
+  const T* find(int k) const { return has(k) ? v + k : nullptr; }
+  bool empty() const { return count == 0; }
+  // operator[]-style access: carve on first touch, value-initialize on
+  // a fresh key (matching std::map's operator[]), return the slot.
+  T& ref(EpochArena& a, int n, int k) {
+    if (!v) {
+      size_t words = ((size_t)n + 63) / 64;
+      v = (T*)a.alloc(sizeof(T) * (size_t)n);
+      present = (uint64_t*)a.alloc(8 * words);
+      std::memset(present, 0, 8 * words);
+      cap = n;
+    }
+    uint64_t& w = present[(unsigned)k >> 6];
+    uint64_t bit = 1ULL << ((unsigned)k & 63);
+    if (!(w & bit)) {
+      w |= bit;
+      v[k] = T();
+      ++count;
+    }
+    return v[k];
+  }
+  // Mid-epoch clear (keeps the carve; e.g. ba_next_round's
+  // future_count): presence bits only — values are re-initialized on
+  // the next ref() of each key.
+  void clear() {
+    if (v) std::memset(present, 0, 8 * (((size_t)cap + 63) / 64));
+    count = 0;
+  }
+  // Epoch reset: forget the carve — the arena watermark reclaims the
+  // memory wholesale (this is the whole point: no per-field teardown).
+  void drop() {
+    v = nullptr;
+    present = nullptr;
+    cap = 0;
+    count = 0;
+  }
+};
+
+// ===========================================================================
 // SBV broadcast (sbv_broadcast.py)
 // ===========================================================================
 
@@ -668,13 +864,17 @@ struct Td {
 struct Bcast {
   int proposer = -1;     // lint: not-reset (per-proposer config, assigned in hb_reset_state)
   int data_shards = 0;   // lint: not-reset (per-proposer config, assigned in hb_reset_state)
-  // echos / echo_hashes / readys / can_decode, with insertion order where
-  // Python iterates dict insertion order (readys for Counter()).
-  std::map<int, std::shared_ptr<const ProofData>> echos;
-  std::map<int, Root> echo_hashes;
-  std::map<int, Root> readys;
+  // echos / echo_hashes / readys / can_decode: arena-backed flat maps
+  // keyed by sender id (ISSUE 17; ascending-index iteration preserves
+  // the old std::map ascending-key order everywhere these are walked).
+  // echos holds raw ProofData pointers — ownership is pinned for the
+  // epoch by Node::epoch_pins (arena values must stay trivially
+  // destructible).
+  FlatMap<const ProofData*> echos;
+  FlatMap<Root> echo_hashes;
+  FlatMap<Root> readys;
   std::vector<Root> ready_root_order;  // first-seen order of distinct roots
-  std::map<int, Root> can_decode;
+  FlatMap<Root> can_decode;
   // Incremental per-root tallies (distinct roots stay O(1) in honest
   // runs).  The maps above were walked on EVERY echo/ready delivery to
   // recount — an O(N) rb-tree + ProofData pointer chase per message,
@@ -733,7 +933,7 @@ struct Ba {
   NodeSet terms[2];
   NodeSet term_senders;
   std::vector<std::pair<int, EMsg>> future;
-  std::map<int, int> future_count;  // per-sender future-buffer occupancy
+  FlatMap<int32_t> future_count;  // per-sender future-buffer occupancy
   int decision = -1;
   bool terminated = false;
 };
@@ -757,13 +957,15 @@ struct Proposal {
   // allocated state (BASELINE.md round-4/5 profiles).  EVERY field of
   // Bcast/Ba/Sbv/Proposal must be restored here; a missed field is
   // cross-epoch contamination (the native equivalence suites pin this
-  // byte-for-byte against the Python net).
+  // byte-for-byte against the Python net).  Arena-backed FlatMap
+  // fields are restored with .drop() — their storage is reclaimed by
+  // the single arena watermark reset in hb_reset_state (ISSUE 17).
   void reset() {
-    bc.echos.clear();
-    bc.echo_hashes.clear();
-    bc.readys.clear();
+    bc.echos.drop();
+    bc.echo_hashes.drop();
+    bc.readys.drop();
     bc.ready_root_order.clear();
-    bc.can_decode.clear();
+    bc.can_decode.drop();
     bc.echo_full_by_root.clear();
     bc.echo_any_by_root.clear();
     bc.ready_by_root.clear();
@@ -785,7 +987,7 @@ struct Proposal {
     ba.terms[1] = NodeSet();
     ba.term_senders = NodeSet();
     ba.future.clear();
-    ba.future_count.clear();
+    ba.future_count.drop();
     ba.decision = -1;
     ba.terminated = false;
     value = nullptr;
@@ -811,9 +1013,15 @@ struct EpochState {
   bool subset_done = false;
   bool done_emitted = false;
   bool subset_terminated = false;
-  std::map<int, std::shared_ptr<Td>> decrypts;
+  // Flat by proposer id, presence = non-null (ISSUE 17: flat iteration
+  // 0..n-1 yields the same key set the maps did).  NOT arena-backed:
+  // Td escapes into Pending continuations that can outlive the epoch,
+  // and shared_ptr/BytesP need destructors the arena never runs —
+  // these vectors are sized once in hb_reset_state and nulled per
+  // epoch (a pointer sweep, not an rb-tree teardown).
+  std::vector<std::shared_ptr<Td>> decrypts;
   std::vector<int> accepted_order;  // proposer ids in acceptance order
-  std::map<int, BytesP> plaintexts;  // proposer -> decoded-ok plaintext marker
+  std::vector<BytesP> plaintexts;  // proposer -> decoded-ok plaintext marker
   NodeSet decrypted;
   NodeSet faulty_proposers;
   bool proposed = false;
@@ -826,9 +1034,9 @@ struct EpochState {
   // stay in place.
   void reset_for_epoch() {
     subset_done = done_emitted = subset_terminated = false;
-    decrypts.clear();
+    for (auto& d : decrypts) d = nullptr;
     accepted_order.clear();
-    plaintexts.clear();
+    for (auto& p : plaintexts) p = nullptr;
     decrypted = NodeSet();
     faulty_proposers = NodeSet();
     proposed = batch_emitted = false;
@@ -858,8 +1066,17 @@ struct Hb {
   // cache misses per message at big N, the measured bulk of the
   // COIN-continuation envelope.
   EpochState state;
-  std::map<int, std::vector<std::pair<int, EMsg>>> future;  // epoch -> msgs
-  std::map<int, int> future_per_sender;
+  // Future-epoch buffer as a ring of max_future_epochs+1 vectors
+  // indexed epoch % size (ISSUE 17; sized in hb_reset_state).  Safe
+  // because the insertion window is (epoch, epoch+max_future_epochs]
+  // — fewer epochs than slots, all distinct mod size — and hb_advance
+  // drains each slot exactly when the cursor reaches its epoch, so a
+  // slot never mixes two epochs' messages.
+  std::vector<std::vector<std::pair<int, EMsg>>> future;
+  // Per-sender future-buffer occupancy, flat by sender id (absent ==
+  // 0 under the old map semantics).  Survives epochs within an era
+  // (decremented on replay); fresh per era via `nd.hb = Hb()`.
+  std::vector<int32_t> future_per_sender;
 
   bool encrypt_on(int e) const {
     switch (sched_kind) {
@@ -952,6 +1169,12 @@ struct Node {
   int era = 0;
   Hb hb;                // inline (see Hb.state note); valid iff hb_init
   bool hb_init = false;
+  // Per-epoch bump arena backing the FlatMap state above (ISSUE 17):
+  // ONE watermark reset per epoch advance (hb_reset_state) replaces
+  // the per-container teardown walk.  epoch_pins owns the ProofData
+  // objects whose raw pointers live in Bcast::echos for the epoch.
+  EpochArena arena;
+  std::vector<std::shared_ptr<const ProofData>> epoch_pins;
   std::vector<Pending> pool;
   bool pool_dirty = false;  // queued in Engine::dirty_nodes (deferred mode)
   uint64_t pool_round = 1;  // bumped per flush swap-round (Ts::grp_round)
@@ -1167,6 +1390,11 @@ struct Engine {
   // escape hatch for the payload pinning if memory ever matters more
   // than the recompute.
   bool ct_hash_cache = true;
+  // HBBFT_TPU_ARENA=0 (read at hbe_create): free the epoch-arena
+  // blocks at every reset instead of recycling them — the one-build
+  // A/B arm for the recycling itself (same flat containers, identical
+  // outputs by construction; docs/INVARIANTS.md "epoch-state arena").
+  bool arena_recycle = true;
   // -- scalar RLC deferred verification (round 7) --------------------------
   // COIN/DECRYPT share checks in scalar mode are deferred to the pool
   // flush and verified per (Ts/Td instance) GROUP with one random-linear-
@@ -1575,6 +1803,38 @@ inline Root merkle_branch_hash(const Root& l, const Root& r) {
   return out;
 }
 
+// Batched Merkle level hashing (sha3 plane).  Leaves: count equal-length
+// shards (pointers; the 0x00 domain prefix is staged here), digests into
+// out[0..count).  Levels: m parent hashes from 2m children — the 65-byte
+// 0x01||l||r messages are staged contiguously and dispatched as one
+// batch.  Digests equal the per-call merkle_leaf_hash/merkle_branch_hash
+// values exactly (same FIPS-202 arm contract), so tree roots and proofs
+// are byte-identical to the unbatched forms.
+inline void merkle_leaves_hash(const uint8_t* const* shards, size_t shard_len,
+                               size_t count, Root* out) {
+  if (!count) return;
+  size_t msg_len = 1 + shard_len;
+  std::vector<uint8_t> stage(count * msg_len);
+  for (size_t i = 0; i < count; ++i) {
+    uint8_t* m = stage.data() + i * msg_len;
+    m[0] = 0x00;
+    std::memcpy(m + 1, shards[i], shard_len);
+  }
+  hbs::sha3_256_batch(stage.data(), msg_len, count, out[0].data());
+}
+
+inline void merkle_reduce_level(const Root* children, size_t m, Root* out) {
+  if (!m) return;
+  std::vector<uint8_t> stage(m * 65);
+  for (size_t i = 0; i < m; ++i) {
+    uint8_t* msg = stage.data() + i * 65;
+    msg[0] = 0x01;
+    std::memcpy(msg + 1, children[2 * i].data(), 32);
+    std::memcpy(msg + 33, children[2 * i + 1].data(), 32);
+  }
+  hbs::sha3_256_batch(stage.data(), 65, m, out[0].data());
+}
+
 inline int merkle_depth(int n_leaves) {
   int d = 0, size = 1;
   while (size < n_leaves) {
@@ -1633,30 +1893,55 @@ inline bool rbc_unpack(const std::vector<Bytes>& data_shards, Bytes& out) {
   return true;
 }
 
-// Cached systematic RS matrix (same semantics as gf256.encoding_matrix).
-inline const std::vector<uint8_t>* rs_matrix(int k, int n) {
-  static std::map<std::pair<int, int>, std::vector<uint8_t>> cache;
+// Cached systematic RS matrices (same semantics as gf256.encoding_matrix).
+// Capped FIFO + mutex + shared_ptr returns (ISSUE 17 satellite: these two
+// were the engine's last genuinely unbounded pure-function caches — the
+// per-engine decoded_roots / mask_by_acc / ct_hash_by_payload caches have
+// carried FIFO caps since rounds 6/7).  A (k, n) key changes only with
+// the validator-set size, so 64 entries is roomy even across many eras;
+// the shared_ptr keeps an evicted matrix alive for callers mid-matmul,
+// and the mutex makes first-build races (mt workers decode concurrently)
+// well-defined instead of accidentally-ordered.
+const size_t RS_MATRIX_CACHE_MAX = 64;
+
+template <typename Sym, bool (*BUILD)(int, int, std::vector<Sym>&)>
+inline std::shared_ptr<const std::vector<Sym>> rs_matrix_cached(int k, int n) {
+  static std::mutex mu;
+  static std::map<std::pair<int, int>,
+                  std::shared_ptr<const std::vector<Sym>>> cache;
+  static std::deque<std::pair<int, int>> order;
   auto key = std::make_pair(k, n);
+  std::lock_guard<std::mutex> lk(mu);
   auto it = cache.find(key);
   if (it == cache.end()) {
-    std::vector<uint8_t> m;
-    if (!hbn::encoding_matrix_t<std::vector<uint8_t>>(k, n, m)) return nullptr;
-    it = cache.emplace(key, std::move(m)).first;
+    std::vector<Sym> m;
+    if (!BUILD(k, n, m)) return nullptr;
+    if (cache.size() >= RS_MATRIX_CACHE_MAX) {
+      cache.erase(order.front());
+      order.pop_front();
+    }
+    it = cache
+             .emplace(key, std::make_shared<const std::vector<Sym>>(
+                               std::move(m)))
+             .first;
+    order.push_back(key);
   }
-  return &it->second;
+  return it->second;
 }
 
-inline const std::vector<uint16_t>* rs16_matrix(int k, int n) {
-  static std::map<std::pair<int, int>, std::vector<uint16_t>> cache;
-  auto key = std::make_pair(k, n);
-  auto it = cache.find(key);
-  if (it == cache.end()) {
-    std::vector<uint16_t> m;
-    if (!hbn::encoding_matrix16_t<std::vector<uint16_t>>(k, n, m))
-      return nullptr;
-    it = cache.emplace(key, std::move(m)).first;
-  }
-  return &it->second;
+inline bool rs_build8(int k, int n, std::vector<uint8_t>& m) {
+  return hbn::encoding_matrix_t<std::vector<uint8_t>>(k, n, m);
+}
+inline bool rs_build16(int k, int n, std::vector<uint16_t>& m) {
+  return hbn::encoding_matrix16_t<std::vector<uint16_t>>(k, n, m);
+}
+
+inline std::shared_ptr<const std::vector<uint8_t>> rs_matrix(int k, int n) {
+  return rs_matrix_cached<uint8_t, rs_build8>(k, n);
+}
+
+inline std::shared_ptr<const std::vector<uint16_t>> rs16_matrix(int k, int n) {
+  return rs_matrix_cached<uint16_t, rs_build16>(k, n);
 }
 
 inline int rs_align(int n) { return n > 255 ? 2 : 1; }
@@ -1666,7 +1951,7 @@ inline int rs_align(int n) { return n > 255 ? 2 : 1; }
 inline bool rs_encode_rows(int k, int n, const uint8_t* data, size_t size,
                            std::vector<uint8_t>& parity) {
   if (n <= 255) {
-    const std::vector<uint8_t>* mat = rs_matrix(k, n);
+    auto mat = rs_matrix(k, n);
     if (!mat) return false;
     parity.assign((size_t)(n - k) * size, 0);
     hbn::gf_matmul(mat->data() + (size_t)k * k, data, parity.data(), n - k, k,
@@ -1674,7 +1959,7 @@ inline bool rs_encode_rows(int k, int n, const uint8_t* data, size_t size,
     return true;
   }
   if (size % 2) return false;
-  const std::vector<uint16_t>* mat = rs16_matrix(k, n);
+  auto mat = rs16_matrix(k, n);
   if (!mat) return false;
   size_t nsym = size / 2;
   std::vector<uint16_t> dsym((size_t)k * nsym);
@@ -1696,7 +1981,7 @@ inline bool rs_reconstruct_rows(int k, int n,
   for (uint64_t idx : idxs)
     if (idx >= (uint64_t)n) return false;
   if (n <= 255) {
-    const std::vector<uint8_t>* mat = rs_matrix(k, n);
+    auto mat = rs_matrix(k, n);
     if (!mat) return false;
     std::vector<uint8_t> sub((size_t)k * k), dec((size_t)k * k);
     for (int r = 0; r < k; ++r)
@@ -1708,7 +1993,7 @@ inline bool rs_reconstruct_rows(int k, int n,
     return true;
   }
   if (size % 2) return false;
-  const std::vector<uint16_t>* mat = rs16_matrix(k, n);
+  auto mat = rs16_matrix(k, n);
   if (!mat) return false;
   std::vector<uint16_t> sub((size_t)k * k), dec((size_t)k * k);
   for (int r = 0; r < k; ++r)
@@ -2343,9 +2628,10 @@ struct Ctx {
           ba.future.push_back({sender, m});
         } else {
           if (ba.future_count.empty())
-            for (auto& sm : ba.future) ba.future_count[sm.first]++;
-          int& cnt = ba.future_count[sender];
-          if (cnt < (int)cap) {
+            for (auto& sm : ba.future)
+              ba.future_count.ref(node.arena, e.n, sm.first)++;
+          int32_t& cnt = ba.future_count.ref(node.arena, e.n, sender);
+          if (cnt < (int32_t)cap) {
             ++cnt;
             ba.future.push_back({sender, m});
           }
@@ -2503,18 +2789,26 @@ struct Ctx {
     for (int i = k; i < n(); ++i)
       shards.push_back(
           Bytes((const char*)parity.data() + (size_t)(i - k) * size, size));
-    // Merkle tree over n() (validator-count) leaves + per-validator proofs
+    // Merkle tree over n() (validator-count) leaves + per-validator
+    // proofs — leaf and branch levels go through the batched sha3 plane
+    // (padding leaves all hash the same empty shard: one digest, copied).
     int depth = merkle_depth(n());
     int tree_size = 1 << depth;
     std::vector<std::vector<Root>> levels(1);
-    for (int i = 0; i < n(); ++i) levels[0].push_back(merkle_leaf_hash(shards[i]));
-    for (int i = n(); i < tree_size; ++i)
-      levels[0].push_back(merkle_leaf_hash(Bytes()));
+    levels[0].resize(tree_size);
+    {
+      std::vector<const uint8_t*> ptrs(n());
+      for (int i = 0; i < n(); ++i) ptrs[i] = (const uint8_t*)shards[i].data();
+      merkle_leaves_hash(ptrs.data(), size, n(), levels[0].data());
+    }
+    if (n() < tree_size) {
+      Root pad = merkle_leaf_hash(Bytes());
+      for (int i = n(); i < tree_size; ++i) levels[0][i] = pad;
+    }
     while ((int)levels.back().size() > 1) {
       const std::vector<Root>& prev = levels.back();
-      std::vector<Root> next;
-      for (size_t i = 0; i < prev.size(); i += 2)
-        next.push_back(merkle_branch_hash(prev[i], prev[i + 1]));
+      std::vector<Root> next(prev.size() / 2);
+      merkle_reduce_level(prev.data(), next.size(), next.data());
       levels.push_back(std::move(next));
     }
     Root root = levels.back()[0];
@@ -2579,8 +2873,8 @@ struct Ctx {
   void bc_handle_value(EpochState& st, int proposer, Bcast& bc, int sender,
                        std::shared_ptr<const ProofData> proof) {
     if (bc.echo_sent) {
-      auto it = bc.echos.find(node.id);
-      if (it != bc.echos.end() && proof->root != it->second->root)
+      const ProofData* const* it = bc.echos.find(node.id);
+      if (it && proof->root != (*it)->root)
         ops.fault(sender, F_BC_MULTI_VALUE);
       return;
     }
@@ -2595,9 +2889,9 @@ struct Ctx {
     // Echo to those (broadcast.py _handle_value).
     NodeSet hash_only;
     bool any_hash_only = false;
-    for (auto& kv : bc.can_decode)
-      if (kv.second == proof->root) {
-        hash_only.add(kv.first);
+    for (int i = 0; i < bc.can_decode.cap; ++i)
+      if (bc.can_decode.has(i) && bc.can_decode.v[i] == proof->root) {
+        hash_only.add(i);
         any_hash_only = true;
       }
     EMsg em;
@@ -2629,9 +2923,9 @@ struct Ctx {
 
   void bc_handle_echo(EpochState& st, int proposer, Bcast& bc, int sender,
                       std::shared_ptr<const ProofData> proof) {
-    auto it = bc.echos.find(sender);
-    if (it != bc.echos.end()) {
-      const ProofData& prev = *it->second;
+    const ProofData* const* it = bc.echos.find(sender);
+    if (it) {
+      const ProofData& prev = **it;
       if (!(prev.value == proof->value && prev.index == proof->index &&
             prev.path == proof->path && prev.root == proof->root))
         ops.fault(sender, F_BC_DUP);
@@ -2645,16 +2939,17 @@ struct Ctx {
       ops.fault(sender, F_BC_INVALID_PROOF);
       return;
     }
-    auto hit = bc.echo_hashes.find(sender);
-    if (hit != bc.echo_hashes.end() && hit->second != proof->root) {
+    const Root* hit = bc.echo_hashes.find(sender);
+    if (hit && *hit != proof->root) {
       ops.fault(sender, F_BC_DUP);
       return;
     }
-    bc.echos[sender] = proof;
+    bc.echos.ref(node.arena, e.n, sender) = proof.get();
+    node.epoch_pins.push_back(proof);  // epoch-long ownership (arena note)
     Bcast::bump(bc.echo_full_by_root, proof->root);
     // A same-root EchoHash from this sender was already tallied in
     // echo_any_by_root (the union count de-duplicates senders).
-    if (hit == bc.echo_hashes.end())
+    if (!hit)
       Bcast::bump(bc.echo_any_by_root, proof->root);
     bc_maybe_can_decode(st, proposer, bc, proof->root);
     if (bc_echo_count(bc, proof->root) >= n() - f() && !bc.ready_sent)
@@ -2664,13 +2959,14 @@ struct Ctx {
 
   void bc_handle_echo_hash(EpochState& st, int proposer, Bcast& bc, int sender,
                            const Root& root) {
-    if (bc.echo_hashes.count(sender) || bc.echos.count(sender)) {
-      Root prev = bc.echo_hashes.count(sender) ? bc.echo_hashes[sender]
-                                               : bc.echos[sender]->root;
+    const Root* eh = bc.echo_hashes.find(sender);
+    const ProofData* const* ec = bc.echos.find(sender);
+    if (eh || ec) {
+      Root prev = eh ? *eh : (*ec)->root;
       if (prev != root) ops.fault(sender, F_BC_DUP);
       return;
     }
-    bc.echo_hashes[sender] = root;
+    bc.echo_hashes.ref(node.arena, e.n, sender) = root;
     Bcast::bump(bc.echo_any_by_root, root);
     if (bc_echo_count(bc, root) >= n() - f() && !bc.ready_sent)
       bc_send_ready(st, proposer, bc, root);
@@ -2681,12 +2977,12 @@ struct Ctx {
                             int sender, const Root& root) {
     (void)st;
     (void)proposer;
-    auto it = bc.can_decode.find(sender);
-    if (it != bc.can_decode.end()) {
-      if (it->second != root) ops.fault(sender, F_BC_DUP);
+    const Root* it = bc.can_decode.find(sender);
+    if (it) {
+      if (*it != root) ops.fault(sender, F_BC_DUP);
       return;
     }
-    bc.can_decode[sender] = root;
+    bc.can_decode.ref(node.arena, e.n, sender) = root;
   }
 
   void bc_maybe_can_decode(EpochState& st, int proposer, Bcast& bc,
@@ -2704,12 +3000,12 @@ struct Ctx {
 
   void bc_handle_ready(EpochState& st, int proposer, Bcast& bc, int sender,
                        const Root& root) {
-    auto it = bc.readys.find(sender);
-    if (it != bc.readys.end()) {
-      if (it->second != root) ops.fault(sender, F_BC_DUP);
+    const Root* it = bc.readys.find(sender);
+    if (it) {
+      if (*it != root) ops.fault(sender, F_BC_DUP);
       return;
     }
-    bc.readys[sender] = root;
+    bc.readys.ref(node.arena, e.n, sender) = root;
     int count = Bcast::bump(bc.ready_by_root, root);
     if (count == 1) bc.ready_root_order.push_back(root);
     if (count >= f() + 1 && !bc.ready_sent)
@@ -2737,9 +3033,13 @@ struct Ctx {
       // Reference the shard bytes in place — materializing copies on
       // every decode attempt dominated big-payload (DKG) epochs.
       std::map<int, const Bytes*> shards;  // index -> value (last write wins)
-      for (auto& kv : bc.echos)
-        if (kv.second->root == root)
-          shards[kv.second->index] = &kv.second->value;
+      // Ascending sender-id walk == the old map's ascending-key walk,
+      // so "last write wins" resolves identically per shard index.
+      for (int s = 0; s < bc.echos.cap; ++s) {
+        if (!bc.echos.has(s)) continue;
+        const ProofData* pd = bc.echos.v[s];
+        if (pd->root == root) shards[pd->index] = &pd->value;
+      }
       if ((int)shards.size() < bc.data_shards) continue;
       // Network-wide decode cache (see Engine::decoded_roots).
       {
@@ -2792,18 +3092,23 @@ struct Ctx {
       }
       int depth = merkle_depth(n());
       int tree_size = 1 << depth;
-      std::vector<Root> level;
-      for (int i = 0; i < n(); ++i) {
-        const uint8_t* src = i < k ? data.data() + (size_t)i * len0
-                                   : parity.data() + (size_t)(i - k) * len0;
-        level.push_back(merkle_leaf_hash(Bytes((const char*)src, len0)));
+      // batched sha3 plane: leaf level straight off the decoded rows (no
+      // per-shard Bytes copies), branch levels as contiguous batches.
+      std::vector<Root> level(tree_size);
+      {
+        std::vector<const uint8_t*> ptrs(n());
+        for (int i = 0; i < n(); ++i)
+          ptrs[i] = i < k ? data.data() + (size_t)i * len0
+                          : parity.data() + (size_t)(i - k) * len0;
+        merkle_leaves_hash(ptrs.data(), len0, n(), level.data());
       }
-      for (int i = n(); i < tree_size; ++i)
-        level.push_back(merkle_leaf_hash(Bytes()));
+      if (n() < tree_size) {
+        Root pad = merkle_leaf_hash(Bytes());
+        for (int i = n(); i < tree_size; ++i) level[i] = pad;
+      }
       while (level.size() > 1) {
-        std::vector<Root> next;
-        for (size_t i = 0; i < level.size(); i += 2)
-          next.push_back(merkle_branch_hash(level[i], level[i + 1]));
+        std::vector<Root> next(level.size() / 2);
+        merkle_reduce_level(level.data(), next.size(), next.data());
         level = std::move(next);
       }
       if (level[0] != root) {
@@ -2840,11 +3145,9 @@ struct Ctx {
   // ---- ThresholdDecrypt ---------------------------------------------------
 
   std::shared_ptr<Td> hb_get_decrypt(EpochState& st, int proposer) {
-    auto it = st.decrypts.find(proposer);
-    if (it != st.decrypts.end()) return it->second;
-    auto td = std::make_shared<Td>();
-    st.decrypts[proposer] = td;
-    return td;
+    std::shared_ptr<Td>& slot = st.decrypts[proposer];
+    if (!slot) slot = std::make_shared<Td>();
+    return slot;
   }
 
   // hash_to_g2 of the ct hash input, once per distinct committed
@@ -3228,18 +3531,10 @@ struct Ctx {
     if (st.decrypted.has(proposer) || st.faulty_proposers.has(proposer)) return;
     int ok = 1;
     if (e.contrib_cb) {
-      // Slot 15: cycles inside the Python contrib callback (the
-      // InternalContrib serde-decode half of the era-change tail) —
-      // with slot 12 this splits the slot-13/14 continuation totals
-      // into decode vs batch-processing before/after the batch-digest
-      // fast path.
-      uint64_t t0 = prof_tick();
+      // (Slot 15 retired its round-6 contrib_cb stamp for the arena
+      // stats — see hb_reset_state and the slot registry.)
       ok = e.contrib_cb(node.id, node.era, st.epoch, proposer,
                         (const uint8_t*)data->data(), data->size());
-      if (!e.mt_active) {
-        e.prof_cycles[15] += prof_tick() - t0;
-        e.prof_count[15]++;
-      }
     }
     if (!ok) {
       st.faulty_proposers.add(proposer);
@@ -3260,7 +3555,8 @@ struct Ctx {
     bd.era = node.era;
     bd.epoch = st.epoch;
     std::vector<int> ids;
-    for (auto& kv : st.plaintexts) ids.push_back(kv.first);
+    for (int p = 0; p < (int)st.plaintexts.size(); ++p)
+      if (st.plaintexts[p]) ids.push_back(p);
     ids = str_sorted(ids);
     for (int p : ids) bd.contributions.push_back({p, st.plaintexts[p]});
     trace_emit(e, node.id, TR_EPOCH_COMMIT, node.era, st.epoch,
@@ -3345,7 +3641,24 @@ struct Ctx {
     canon_append(ss, canon_int_bytes((uint64_t)epoch));
     st.subset_session = ss;
     st.proposals.resize(e.n);
+    st.decrypts.resize(e.n);
+    st.plaintexts.resize(e.n);
+    node.hb.future.resize((size_t)node.hb.max_future_epochs + 1);
+    node.hb.future_per_sender.resize(e.n, 0);
     for (Proposal& p : st.proposals) p.reset();
+    // THE arena reset (ISSUE 17): every FlatMap above was dropped by
+    // Proposal::reset, so the epoch's flat state is reclaimed by one
+    // watermark move (blocks poisoned between epochs under ASan).
+    // epoch_pins releases the ProofData ownership the echos maps
+    // borrowed.  Slot 15 (registry): arena stats — cycles = max
+    // per-node high-water mark (bytes), count = resets.
+    node.arena.reset(e.arena_recycle);
+    node.epoch_pins.clear();
+    if (!e.mt_active) {
+      if ((uint64_t)node.arena.hwm > e.prof_cycles[15])
+        e.prof_cycles[15] = node.arena.hwm;
+      e.prof_count[15]++;
+    }
     for (int pid : node.val_ids) {
       Proposal& p = st.proposals[pid];
       p.bc.proposer = pid;
@@ -3394,20 +3707,13 @@ struct Ctx {
       } else {
         hb_reset_state(hb.state, hb.epoch);
       }
-      auto it = hb.future.find(hb.epoch);
       std::vector<std::pair<int, EMsg>> replay;
-      if (it != hb.future.end()) {
-        replay = std::move(it->second);
-        hb.future.erase(it);
-      }
+      replay.swap(hb.future[(size_t)hb.epoch % hb.future.size()]);
       for (auto& sm : replay) {
-        auto fit = hb.future_per_sender.find(sm.first);
-        if (fit != hb.future_per_sender.end()) {
-          if (fit->second > 1)
-            fit->second -= 1;
-          else
-            hb.future_per_sender.erase(fit);
-        }
+        // absent == 0 under the old map semantics, so >1-decrement /
+        // ==1-erase collapses to a floor-at-zero decrement.
+        int32_t& fc = hb.future_per_sender[sm.first];
+        if (fc > 0) fc -= 1;
         // typed re-attribution — see ba_next_round's replay loop
         if (!e.mt_active) {
           uint64_t t0 = prof_tick();
@@ -3457,15 +3763,13 @@ struct Ctx {
     if (m.epoch > hb.epoch) {
       int cap = FUTURE_BUFFER_FACTOR * (hb.max_future_epochs + 1) *
                 (n() > 1 ? n() : 1);
-      int buffered = 0;
-      auto it = hb.future_per_sender.find(sender);
-      if (it != hb.future_per_sender.end()) buffered = it->second;
+      int buffered = hb.future_per_sender[sender];
       if (buffered >= cap) {
         ops.fault(sender, F_HB_FLOOD);
         return;
       }
       hb.future_per_sender[sender] = buffered + 1;
-      hb.future[m.epoch].push_back({sender, m});
+      hb.future[(size_t)m.epoch % hb.future.size()].push_back({sender, m});
       return;
     }
     hb_state_dispatch(sender, m);
@@ -5575,6 +5879,47 @@ void hbe_field_mul_batch(const uint8_t* a_be, const uint8_t* b_be, int32_t n,
   for (int32_t i = 0; i < n; ++i) u256_to_be32(out[i], out_be + 32 * i);
 }
 
+// --- Batched sha3 plane test/stats surface (round 17) ----------------------
+
+// SHA3-256 of `count` contiguous messages of `msg_len` bytes; 32-byte
+// digests contiguous at out.  The sha3-plane fuzz surface: dispatches
+// exactly as the engine's kdf/Merkle consumers do (8-lane arm for full
+// groups when enabled, scalar tail), so both arms are pinnable from the
+// tests via hbe_simd_force.
+void hbe_sha3_batch(const uint8_t* msgs, uint64_t msg_len, uint64_t count,
+                    uint8_t* out) {
+  hbs::sha3_256_batch(msgs, (size_t)msg_len, (size_t)count, out);
+}
+
+// Plane counters since process start: {batch_calls, batch_msgs,
+// ifma_msgs, single_msgs}.  Library-global (the plane is one dispatch
+// point, not per-engine); benchmark lines report deltas or totals.
+void hbe_sha3_stats(uint64_t out[4]) {
+  hbs::Sha3Stats& s = hbs::stats();
+  out[0] = s.batch_calls.load(std::memory_order_relaxed);
+  out[1] = s.batch_msgs.load(std::memory_order_relaxed);
+  out[2] = s.ifma_msgs.load(std::memory_order_relaxed);
+  out[3] = s.single_msgs.load(std::memory_order_relaxed);
+}
+
+// Epoch-arena telemetry across this engine's nodes: {max per-node
+// high-water mark (bytes/epoch), sum of per-node high-water marks,
+// total watermark resets, recycle knob (HBBFT_TPU_ARENA)}.  Benchmark
+// lines report these so arena A/Bs are self-documenting.
+void hbe_arena_stats(void* h, uint64_t out[4]) {
+  Engine& e = *(Engine*)h;
+  uint64_t mx = 0, sum = 0, rs = 0;
+  for (Node& nd : e.nodes) {
+    if ((uint64_t)nd.arena.hwm > mx) mx = nd.arena.hwm;
+    sum += nd.arena.hwm;
+    rs += nd.arena.resets;
+  }
+  out[0] = mx;
+  out[1] = sum;
+  out[2] = rs;
+  out[3] = e.arena_recycle ? 1 : 0;
+}
+
 // sum_i a_i*b_i mod r (the combine-sum kernel's fuzz surface).
 void hbe_field_dot(const uint8_t* a_be, const uint8_t* b_be, int32_t n,
                    uint8_t* out32) {
@@ -5640,6 +5985,8 @@ void* hbe_create(int32_t n, int32_t f) {
   e->ct_hash_cache = !(g && g[0] == '0' && !g[1]);
   const char* r = getenv("HBBFT_TPU_COIN_RLC");
   e->rlc = !(r && r[0] == '0' && !r[1]);
+  const char* a = getenv("HBBFT_TPU_ARENA");
+  e->arena_recycle = !(a && a[0] == '0' && !a[1]);
   return e;
 }
 

@@ -319,6 +319,59 @@ void fold_acc(__m512i t[7], uint64_t acc8[8]) {
   }
 }
 
+// ---- 8-lane state-parallel Keccak-f[1600] (batched sha3 plane,
+// native/sha3_plane.h) -----------------------------------------------------
+//
+// Eight independent FIPS-202 SHA3-256 states side by side: Keccak state
+// word w of message j lives in qword lane j of st[w].  Rotations use
+// vprolvq (broadcast counts — the intrinsic with an immediate count
+// cannot take a table value from a loop), chi is one vpternlogq per
+// word (imm 0xD2 = a ^ (~b & c)).  Round constants / rotation offsets
+// are duplicated from sha3_gf.h on purpose — this unit includes no
+// shared inline code (COMDAT rule, header comment).
+
+const uint64_t KC_RC[24] = {
+    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+const int KC_RHO[25] = {0,  1,  62, 28, 27, 36, 44, 6,  55, 20, 3,  10,
+                        43, 25, 39, 41, 45, 15, 21, 8,  18, 2,  61, 56,
+                        14};
+
+void keccak_f_x8(__m512i st[25]) {
+  for (int round = 0; round < 24; ++round) {
+    __m512i c[5], d[5];
+    for (int x = 0; x < 5; ++x)
+      c[x] = _mm512_xor_epi64(
+          _mm512_xor_epi64(_mm512_xor_epi64(st[x], st[x + 5]),
+                           _mm512_xor_epi64(st[x + 10], st[x + 15])),
+          st[x + 20]);
+    for (int x = 0; x < 5; ++x) {
+      d[x] = _mm512_xor_epi64(c[(x + 4) % 5],
+                              _mm512_rol_epi64(c[(x + 1) % 5], 1));
+      for (int y = 0; y < 5; ++y)
+        st[x + 5 * y] = _mm512_xor_epi64(st[x + 5 * y], d[x]);
+    }
+    __m512i b[25];
+    for (int x = 0; x < 5; ++x)
+      for (int y = 0; y < 5; ++y)
+        b[y + 5 * ((2 * x + 3 * y) % 5)] = _mm512_rolv_epi64(
+            st[x + 5 * y], _mm512_set1_epi64(KC_RHO[x + 5 * y]));
+    for (int y = 0; y < 5; ++y)
+      for (int x = 0; x < 5; ++x)
+        st[x + 5 * y] = _mm512_ternarylogic_epi64(
+            b[x + 5 * y], b[(x + 1) % 5 + 5 * y], b[(x + 2) % 5 + 5 * y],
+            0xD2);
+    st[0] = _mm512_xor_epi64(st[0],
+                             _mm512_set1_epi64((long long)KC_RC[round]));
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -434,6 +487,49 @@ void hbf_ifma_rlc_accum(const uint64_t* x, const uint64_t* coeffs, size_t n,
   if (since_fold) fold_acc(t, acc8);
 }
 
+// SHA3-256 of 8 equal-length messages (contiguous, stride msg_len);
+// digests contiguous (32 bytes each) at out.  Full rate blocks are
+// absorbed by qword gathers straight from the messages; the final
+// padded block is staged scalar-side (FIPS-202: 0x06 after the tail,
+// 0x80 into the last rate byte) so short tails never read past a
+// message.  Digest-identical to hbn::sha3_256 per message — the sha3
+// plane's dispatch-identity contract rests on exactly that.
+void hbf_ifma_sha3_256_x8(const uint8_t* in, size_t msg_len, uint8_t* out) {
+  const size_t RATE = 136;  // SHA3-256
+  __m512i st[25];
+  for (int i = 0; i < 25; ++i) st[i] = _mm512_setzero_si512();
+  const __m512i midx = _mm512_setr_epi64(
+      0, (long long)msg_len, (long long)(2 * msg_len), (long long)(3 * msg_len),
+      (long long)(4 * msg_len), (long long)(5 * msg_len),
+      (long long)(6 * msg_len), (long long)(7 * msg_len));
+  size_t nfull = msg_len / RATE;
+  for (size_t b = 0; b < nfull; ++b) {
+    const uint8_t* base = in + b * RATE;
+    for (int i = 0; i < 17; ++i) {
+      __m512i w = _mm512_i64gather_epi64(midx, (const void*)(base + 8 * i), 1);
+      st[i] = _mm512_xor_epi64(st[i], w);
+    }
+    keccak_f_x8(st);
+  }
+  size_t rem = msg_len - nfull * RATE;
+  alignas(64) uint8_t stage[8 * 136];
+  std::memset(stage, 0, sizeof(stage));
+  for (int j = 0; j < 8; ++j) {
+    std::memcpy(stage + j * RATE, in + j * msg_len + nfull * RATE, rem);
+    stage[j * RATE + rem] = 0x06;
+    stage[j * RATE + RATE - 1] ^= 0x80;
+  }
+  const __m512i sidx = _mm512_setr_epi64(0, 136, 272, 408, 544, 680, 816, 952);
+  for (int i = 0; i < 17; ++i) {
+    __m512i w = _mm512_i64gather_epi64(sidx, (const void*)(stage + 8 * i), 1);
+    st[i] = _mm512_xor_epi64(st[i], w);
+  }
+  keccak_f_x8(st);
+  const __m512i oidx = _mm512_setr_epi64(0, 32, 64, 96, 128, 160, 192, 224);
+  for (int w = 0; w < 4; ++w)
+    _mm512_i64scatter_epi64((void*)(out + 8 * w), oidx, st[w], 1);
+}
+
 }  // extern "C"
 
 #else  // !__AVX512IFMA__: stub arm (never dispatched to)
@@ -450,6 +546,7 @@ void hbf_ifma_dot_acc(const uint64_t*, const uint64_t*, size_t,
 void hbf_ifma_lagrange_dens(const int64_t*, size_t, uint64_t*) {}
 void hbf_ifma_rlc_accum(const uint64_t*, const uint64_t*, size_t, uint64_t[8]) {
 }
+void hbf_ifma_sha3_256_x8(const uint8_t*, size_t, uint8_t*) {}
 
 }  // extern "C"
 

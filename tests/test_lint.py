@@ -529,6 +529,25 @@ def test_engine_patched_unreset_proposal_field_flagged(engine_src):
     assert any("sneaky_counter" in x.message for x in f)
 
 
+def test_engine_patched_flatmap_clear_flagged(engine_src):
+    # The arena reset model (round 17): a FlatMap field restored with
+    # .clear() instead of .drop() keeps a carve pointer into arena
+    # memory across the watermark reset — name-mention must NOT pass.
+    patched = engine_src.replace("bc.echos.drop();", "bc.echos.clear();")
+    assert patched != engine_src
+    f = [x for x in lint_source(patched) if x.rule == "HBC001"]
+    assert any("echos" in x.message and ".drop()" in x.message for x in f)
+
+
+def test_engine_patched_missing_arena_watermark_flagged(engine_src):
+    # Removing the single arena.reset( site must fail: every dropped
+    # FlatMap carve relies on it for reclamation.
+    patched = engine_src.replace("arena.reset(", "arena_reset_disabled(")
+    assert patched != engine_src
+    f = [x for x in lint_source(patched) if x.rule == "HBC001"]
+    assert any("watermark" in x.message for x in f)
+
+
 def test_engine_patched_free_slot_write_flagged(engine_src, monkeypatch):
     # Every slot is claimed as of round 6 (12/15 = batch/contrib wall),
     # so simulate releasing slot 12: the claim-before-stamp rule must

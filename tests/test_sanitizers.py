@@ -284,6 +284,56 @@ for arm in (0, 1):
 flib.hbe_simd_force(-1)
 assert arm_results[0] == arm_results[1], "SIMD arms diverged"
 print("SANITIZED-SIMD-OK")
+
+# Round 17: the epoch arena + batched sha3 plane.  The default arm
+# (ARENA=1, every stage above) POISONS recycled blocks under ASan, so
+# any use-after-reset in the epoch path already trips; here the
+# free-every-epoch arm (HBBFT_TPU_ARENA=0, read at hbe_create) runs
+# the opening script too — both reset models sanitized, first-batch
+# output pinned identical.  The sha3 batch kernel is fuzzed at the
+# SHA3-256 rate boundaries in both dispatch arms against hashlib (the
+# x8 gather/scatter absorb in field_ifma.cpp is where an OOB hides).
+import hashlib as _hl
+import os as _os
+
+for _arm in (0, 1):
+    flib.hbe_simd_force(_arm)
+    for _mlen in (0, 1, 135, 136, 137, 271, 272):
+        for _cnt in (1, 7, 8, 9, 17):
+            _msgs = [
+                bytes((_arm * 31 + i + j) & 0xFF for j in range(_mlen))
+                for i in range(_cnt)
+            ]
+            _out = (ctypes.c_uint8 * (32 * _cnt))()
+            flib.hbe_sha3_batch(b"".join(_msgs), _mlen, _cnt, _out)
+            for i in range(_cnt):
+                assert (
+                    bytes(_out[32 * i : 32 * i + 32])
+                    == _hl.sha3_256(_msgs[i]).digest()
+                ), (_arm, _mlen, _cnt, i)
+flib.hbe_simd_force(-1)
+
+_os.environ["HBBFT_TPU_ARENA"] = "0"
+try:
+    nat17 = native_engine.NativeQhbNet(
+        4, seed=1, batch_size=3, session_id=b"sanitizer", **kw
+    )
+    for i in range(4):
+        nat17.send_input(i, ("tx", i))
+    nat17.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids),
+        chunk=1 if threads == 0 else 256,
+    )
+    keys17 = [
+        [(b.era, b.epoch, b.contributions) for b in nat17.nodes[i].outputs[:1]]
+        for i in nat17.correct_ids
+    ]
+    assert keys17 == keys, "ARENA=0 arm diverged from the recycling arm"
+    assert nat17.arena_stats()["recycle"] == 0
+    nat17.close()
+finally:
+    _os.environ.pop("HBBFT_TPU_ARENA", None)
+print("SANITIZED-ARENA-SHA3-OK")
 """
 
 
@@ -348,6 +398,7 @@ def test_asan_native_epoch():
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
+    assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
     assert "AddressSanitizer" not in res.stderr
 
 
@@ -360,6 +411,7 @@ def test_ubsan_native_epoch():
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
+    assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
     assert "runtime error" not in res.stderr
 
 
@@ -377,4 +429,5 @@ def test_tsan_multithread_epoch():
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
+    assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
     assert "WARNING: ThreadSanitizer" not in res.stderr

@@ -1,0 +1,216 @@
+"""Epoch-state arena + batched sha3 plane (ISSUE 17).
+
+Structure:
+
+* **sha3 batch fuzz** — ``hbe_sha3_batch`` in BOTH dispatch arms
+  (``hbe_simd_force``, the same shared cell as the field plane) against
+  ``hashlib.sha3_256``; count edges straddle the 8-lane grouping
+  (1/7/8/9/16/17) and msg_len edges straddle the SHA3-256 rate
+  boundaries (135/136/137 and the two-block 271/272), plus empty
+  messages.
+* **Stats accounting** — the batch counters' exact deltas per call,
+  including that ``ifma_msgs`` counts only full groups of 8 and only
+  when the IFMA arm resolved.
+* **Arena identity** — the same N=4 script (3 plain epochs + a voted
+  era change) byte-identical across ``HBBFT_TPU_ARENA=0/1`` x forced
+  SIMD arms: batch sequences, fault logs, delivered counts.  The
+  ARENA=0 arm frees every epoch's blocks instead of recycling — same
+  containers, same carve order, outputs identical by construction
+  (docs/INVARIANTS.md "epoch-state arena"), and this pins it.
+* **Telemetry sanity** — ``arena_stats()`` high-water marks / resets /
+  recycle knob, and that a protocol run actually routes hashing through
+  the batch plane (``batch_msgs`` grows).
+
+On hosts without AVX-512 IFMA the force-1 arm resolves to scalar and
+the cross-arm legs degenerate to scalar-vs-scalar (still valid, just
+not discriminating).
+"""
+
+import ctypes
+import hashlib
+import os
+import random
+
+import pytest
+
+from hbbft_tpu import native_engine
+from hbbft_tpu.protocols.dynamic_honey_badger import Change
+from hbbft_tpu.protocols.queueing_honey_badger import Input
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.available(), reason="native engine unavailable"
+)
+
+BATCH_SIZE = 4
+SESSION = b"sha3-arena-tier"
+
+
+@pytest.fixture
+def lib():
+    lib = native_engine.get_lib()
+    yield lib
+    lib.hbe_simd_force(-1)  # back to HBBFT_TPU_SIMD/auto
+
+
+def _arms(lib):
+    for want in (0, 1):
+        got = int(lib.hbe_simd_force(want))
+        if want == 1 and not lib.hbe_simd_compiled():
+            assert got == 0
+        yield want, got
+
+
+def _sha3_stats(lib):
+    buf = (ctypes.c_uint64 * 4)()
+    lib.hbe_sha3_stats(buf)
+    return tuple(int(x) for x in buf)
+
+
+def _batch(lib, msgs):
+    """Drive hbe_sha3_batch over equal-length msgs; return digests."""
+    count = len(msgs)
+    msg_len = len(msgs[0])
+    out = (ctypes.c_uint8 * (32 * count))()
+    lib.hbe_sha3_batch(b"".join(msgs), msg_len, count, out)
+    return [bytes(out[32 * i : 32 * i + 32]) for i in range(count)]
+
+
+def test_sha3_batch_matches_hashlib_both_arms(lib):
+    rng = random.Random(1701)
+    # rate boundaries for SHA3-256 (rate = 136 bytes): one block with
+    # and without room for padding, and the two-block analogues
+    lens = [0, 1, 31, 32, 135, 136, 137, 271, 272, 300]
+    counts = [1, 2, 7, 8, 9, 16, 17]
+    for mode, _ in _arms(lib):
+        for msg_len in lens:
+            for count in counts:
+                msgs = [
+                    bytes(rng.getrandbits(8) for _ in range(msg_len))
+                    for _ in range(count)
+                ]
+                want = [hashlib.sha3_256(m).digest() for m in msgs]
+                assert _batch(lib, msgs) == want, (mode, msg_len, count)
+
+
+def test_sha3_stats_accounting(lib):
+    rng = random.Random(1702)
+    for mode, got in _arms(lib):
+        for count in (3, 8, 19):
+            msgs = [bytes(rng.getrandbits(8) for _ in range(64))
+                    for _ in range(count)]
+            before = _sha3_stats(lib)
+            _batch(lib, msgs)
+            after = _sha3_stats(lib)
+            assert after[0] - before[0] == 1, mode  # batch_calls
+            assert after[1] - before[1] == count, mode  # batch_msgs
+            # ifma_msgs counts whole groups of 8, only on the IFMA arm
+            want_ifma = (count // 8) * 8 if got else 0
+            assert after[2] - before[2] == want_ifma, (mode, count)
+
+
+def _run_script(arena_env, simd_force):
+    """One native run of the shared script under the given arms; env
+    must be set BEFORE NativeQhbNet creation (hbe_create reads the
+    knob), simd force flips the shared dispatch cell in-process."""
+    lib = native_engine.get_lib()
+    prev = os.environ.get("HBBFT_TPU_ARENA")
+    if arena_env is None:
+        os.environ.pop("HBBFT_TPU_ARENA", None)
+    else:
+        os.environ["HBBFT_TPU_ARENA"] = arena_env
+    lib.hbe_simd_force(simd_force)
+    try:
+        nat = native_engine.NativeQhbNet(
+            4, seed=11, batch_size=BATCH_SIZE, num_faulty=0, session_id=SESSION
+        )
+        # 3 plain epochs
+        for k in range(3):
+            for nid in range(4):
+                nat.send_input(nid, Input.user(f"a{k}-{nid}"))
+            nat.run_until(
+                lambda e, w=k + 1: all(
+                    len(e.nodes[i].outputs) >= w for i in e.correct_ids
+                ),
+                chunk=1,
+            )
+        # era change: vote node 3 out (scalar-suite DKG rides consensus)
+        keep = dict(nat.nodes[0].qhb.dhb.netinfo.public_key_map)
+        keep.pop(3)
+        change = Change.node_change(keep)
+        for nid in range(4):
+            nat.send_input(nid, Input.change(change))
+
+        def done(e):
+            return all(
+                any(b.change.kind == "complete" for b in e.nodes[i].outputs)
+                for i in e.correct_ids
+            )
+
+        for r in range(8):
+            if done(nat):
+                break
+            for nid in range(4):
+                nat.send_input(nid, Input.user(f"e{r}-{nid}"))
+            nat.run_until(
+                lambda e, w=r + 4: all(
+                    len(e.nodes[i].outputs) >= w for i in e.correct_ids
+                ),
+                chunk=1,
+            )
+        assert done(nat)
+        batches = [
+            [
+                (b.era, b.epoch, b.contributions, b.change, b.join_plan)
+                for b in nat.nodes[i].outputs
+            ]
+            for i in nat.correct_ids
+        ]
+        faults = [nat.faults(i) for i in nat.correct_ids]
+        stats = nat.arena_stats()
+        delivered = nat.delivered
+        nat.close()
+        return batches, faults, delivered, stats
+    finally:
+        lib.hbe_simd_force(-1)
+        if prev is None:
+            os.environ.pop("HBBFT_TPU_ARENA", None)
+        else:
+            os.environ["HBBFT_TPU_ARENA"] = prev
+
+
+def test_arena_identity_epochs_and_era_change():
+    """The whole ARENA x SIMD matrix commits byte-identical output."""
+    runs = {}
+    for arena_env in ("1", "0"):
+        for simd in (0, 1):
+            batches, faults, delivered, stats = _run_script(arena_env, simd)
+            runs[(arena_env, simd)] = (batches, faults, delivered)
+            assert stats["recycle"] == int(arena_env)
+            assert stats["hwm_max"] > 0
+            assert stats["hwm_sum"] >= stats["hwm_max"]
+            # every node resets its watermark at every epoch open (incl.
+            # the post-era restart): >= 4 epochs x 4 nodes
+            assert stats["resets"] >= 16
+    ref = runs[("1", 0)]
+    for key, got in runs.items():
+        assert got == ref, f"arm {key} diverged from (arena=1, scalar)"
+
+
+def test_protocol_run_feeds_batch_plane():
+    """A plain epoch routes Merkle/KDF hashing through the batch entry
+    (the counters are library-global: compare deltas)."""
+    lib = native_engine.get_lib()
+    before = _sha3_stats(lib)
+    nat = native_engine.NativeQhbNet(4, seed=7, batch_size=BATCH_SIZE)
+    for nid in range(4):
+        nat.send_input(nid, Input.user(f"p{nid}"))
+    nat.run_until(
+        lambda e: all(len(e.nodes[i].outputs) >= 1 for i in e.correct_ids)
+    )
+    st = nat.arena_stats()
+    assert st["hwm_max"] > 0 and st["resets"] >= 4
+    assert st["recycle"] == (os.environ.get("HBBFT_TPU_ARENA", "1") != "0")
+    nat.close()
+    after = _sha3_stats(lib)
+    assert after[1] > before[1]  # batch_msgs grew
+    assert after[3] > before[3]  # single_msgs (ct digest path) grew

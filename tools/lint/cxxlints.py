@@ -10,7 +10,12 @@ these invariants need:
   is cross-epoch contamination (the reset-in-place recycling relies on
   the resets being exhaustive; CLAUDE.md round-5 notes).  Intentionally
   persistent fields carry a ``// lint: not-reset (<why>)`` annotation on
-  their declaration.
+  their declaration.  ``FlatMap``-typed fields (epoch-arena storage,
+  round 17) must specifically call ``.drop()`` in the reset — a
+  ``.clear()`` or whole-object assignment would carry a carve pointer
+  into arena memory across the watermark reset (dangling after the
+  next epoch's carves) — and the file must contain the single
+  ``arena.reset(`` watermark site the drops rely on.
 * HBC002 — profiling-counter writes are single-writer: each literal
   ``prof_cycles``/``prof_count`` write sits under an ``if
   (!e.mt_active))`` guard or in code annotated ``// lint: st-only``.
@@ -378,6 +383,29 @@ def _check_reset_coverage(
                 structs, direct, dotted + ".", body, path, reset_line, findings
             )
             continue
+        if "FlatMap" in type_idents:
+            # Epoch-arena storage (round 17): the reset must forget the
+            # carve with .drop() — name-mention via .clear() or an
+            # assignment would keep v/present pointing into arena
+            # memory that the watermark reset is about to recycle.
+            pat = re.escape(dotted).replace(r"\.", r"\s*\.\s*")
+            if re.search(rf"(?<![\w.]){pat}\s*\.\s*drop\s*\(", body):
+                continue
+            findings.append(
+                Finding(
+                    "HBC001",
+                    path,
+                    decl_line,
+                    f"FlatMap field '{dotted}' of {struct_name} must be"
+                    " restored with '.drop()' in the in-place reset"
+                    f" (line {reset_line}): its storage lives in the"
+                    " epoch arena, so '.clear()' or assignment would"
+                    " carry a dangling carve pointer across the"
+                    " watermark reset (docs/INVARIANTS.md 'epoch-state"
+                    " arena')",
+                )
+            )
+            continue
         if any(t in structs for t in type_idents):
             # Container of tracked structs (std::vector<Proposal>,
             # std::array<Ba, 2>, ...): per-element resets cannot be
@@ -446,6 +474,24 @@ def rule_field_reset(
         reset_line = bodies[owner][0]
         _check_reset_coverage(
             structs, owner, "", mbody, path, reset_line, findings
+        )
+    # Arena watermark site (round 17): the FlatMap .drop() idiom above
+    # only reclaims storage because ONE per-epoch arena.reset( call
+    # exists — if it disappears, every dropped carve leaks until the
+    # node dies.
+    code = "\n".join(code_lines)
+    if re.search(r"\bFlatMap\s*<", code) and not re.search(
+        r"\barena\s*\.\s*reset\s*\(", code
+    ):
+        findings.append(
+            Finding(
+                "HBC001",
+                path,
+                1,
+                "FlatMap fields exist but no 'arena.reset(' watermark"
+                " site does: dropped carves are never reclaimed"
+                " (docs/INVARIANTS.md 'epoch-state arena')",
+            )
         )
     return findings
 

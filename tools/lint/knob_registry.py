@@ -38,6 +38,17 @@ KNOBS: Dict[str, Knob] = {
     k.name: k
     for k in (
         _k(
+            "HBBFT_TPU_ARENA",
+            "1 (on)",
+            "native engine",
+            "`0` makes the per-node epoch arena FREE its blocks at every "
+            "watermark reset instead of recycling them (round-17 A/B "
+            "arm).  Same containers, same carve order — outputs are "
+            "byte-identical either way (docs/INVARIANTS.md \"epoch-state "
+            "arena\"); only allocator traffic differs.  Read once at "
+            "`hbe_create`.",
+        ),
+        _k(
             "HBBFT_TPU_CHUNK",
             "2048",
             "crypto/tpu backend (`TpuBackend`)",

@@ -24,6 +24,12 @@ was SETTLED in round 4 and the deferred-flush folding into the typed
 COIN/DECRYPT slots carries the continuation wall since round 7) and
 re-claimed 14 for the SIMD field plane's combine-kernel stats — the
 COIN/DECRYPT combine component the HBBFT_TPU_SIMD A/B adjudicates.
+Round 17 retired the round-6 slot-15 contrib_cb stamp (its era-change
+tail split was SETTLED in round 6; the decode half has been stable
+since) and re-claimed 15 for the epoch-arena stats — NOT a cycle
+counter: cycles = max per-node arena high-water mark in bytes, count
+= watermark resets (hb_reset_state; exported as arena_stats() and as
+the engine.cyc.arena counter on cluster nodes).
 """
 
 # Dynamic range: prof_cycles[ty] / prof_count[ty], ty = MsgType 0..10.
@@ -40,7 +46,8 @@ CLAIMED_SLOTS = {
     14: "SIMD combine-kernel wall (cycles = Lagrange coefficients + "
         "batched combine-sum at ts/td_try_output, count = scalar-mode "
         "combines; the HBBFT_TPU_SIMD A/B component readout, round 15)",
-    15: "Python contrib_cb wall cycles (hb_accept_plaintext decode split, round 6)",
+    15: "epoch-arena stats (cycles = max per-node high-water mark bytes, "
+        "count = watermark resets; hb_reset_state, round 17)",
 }
 
 # Free for temporary instrumentation: claim here before stamping.
