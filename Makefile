@@ -84,7 +84,7 @@ diag:
 # states; native halves skip cleanly without g++.
 cryptoplane-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cryptoplane.py \
-		-q -m 'not slow'
+		tests/test_cryptoplane_proc.py -q -m 'not slow'
 
 # Engine-plane tier (ISSUE 14 + 17): the vectorized field plane (kernel
 # fuzz + cross-arm identity) and the epoch arena + batched sha3 plane

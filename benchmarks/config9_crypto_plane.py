@@ -25,15 +25,33 @@ Arms:
   scalar suite).  Gated behind ``BENCH_TPU=1``: needs the TPU relay
   (or a long-suffering CPU XLA compile — see CLAUDE.md cold-start
   budgets) and is NOT part of the mandatory matrix.
+* ``service-proc`` — ``crypto="service-proc"`` (round 18): the same
+  shared plane as ``service-cpu`` but in its OWN PROCESS behind the
+  socket RPC boundary, so the column prices serialization + RPC on
+  top of the amortization.  Both impls.
+* ``inline-bls`` — ``crypto="inline"`` with the BLS12-381 suite
+  (python impl: the native wire grammar pins the scalar suite).  The
+  round-18 acceptance BASELINE: every node pays its own pairings.
+* ``service-proc-bls`` — the BLS suite with every node's share checks
+  routed to ONE service process (python impl).  Worker backend is
+  ``batched`` by default; ``BENCH_TPU=1`` switches it to ``tpu``
+  (worker spawned with the relay visible and a compile-scale RPC
+  timeout) — the live-TPU-amortization headline arm.
 
 Drive modes (BENCH_CP_DRIVE): ``open`` (default; honest latency
 percentiles) or ``presubmit`` (deterministic workload — the line
 carries ``batches_sha``, comparable across arms/impls at one seed; do
-not quote presubmit latency).  The fallback drill (service killed
-mid-run, cluster keeps committing) lives in tests/test_cryptoplane.py.
+not quote presubmit latency).  ``BENCH_CP_KILL=1`` arms the mid-run
+service-kill drill on the ``service-proc*`` arms: once every node has
+committed a batch the service process takes a SIGKILL, and the line's
+``kill_drill`` block records the fallback flip (the scripted version
+of the tests/test_cryptoplane_proc.py drill — quote it only when
+``complete`` is true and ``fallbacks`` > 0).
 
 Env: BENCH_CP_NS (default "4"), BENCH_CP_ARMS (default
-"scalar,service-cpu"), BENCH_CP_IMPLS (python|native list, default
+"scalar,service-cpu"; the round-18 acceptance pair is
+"inline-bls,service-proc-bls" at N>=16 presubmit),
+BENCH_CP_IMPLS (python|native list, default
 "python,native"), BENCH_CP_DRIVE (open|presubmit, default open),
 BENCH_CP_DURATION_S (default 2.0), BENCH_CP_TXNS (presubmit workload,
 default 32), BENCH_CP_CLIENTS_PER_NODE (default 2), BENCH_CP_TPS (per
@@ -56,6 +74,7 @@ import hashlib
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -98,7 +117,65 @@ def build_cluster(n: int, arm: str, impl: str, seed: int, window_s: float):
             # benchmark the CPU fallback under a service-tpu label
             service_kwargs=dict(timeout_s=3600.0),
         )
+    if arm == "service-proc":
+        return LocalCluster(
+            n, seed=seed, node_impl=impl, crypto="service-proc",
+            service_kwargs=dict(window_s=window_s),
+        )
+    if arm in ("inline-bls", "service-proc-bls"):
+        from hbbft_tpu.crypto.bls import BLSSuite
+
+        suite = BLSSuite()
+        if arm == "inline-bls":
+            return LocalCluster(
+                n, seed=seed, node_impl="python", suite=suite,
+                crypto="inline",
+            )
+        kw: dict = dict(window_s=window_s, backend="batched")
+        if os.environ.get("BENCH_TPU") == "1":
+            # compile-scale RPC timeout, relay visible in the worker: a
+            # cold flush bucket is a multi-minute XLA build, and the 30 s
+            # default would silently benchmark the CPU fallback under a
+            # service label
+            kw = dict(
+                window_s=window_s, backend="tpu",
+                timeout_s=3600.0, force_cpu_jax=False,
+            )
+        return LocalCluster(
+            n, seed=seed, node_impl="python", suite=suite,
+            crypto="service-proc", service_kwargs=kw,
+        )
     raise ValueError(f"unknown arm {arm!r}")
+
+
+def arm_kill_drill(cluster, kill_info: dict, deadline_s: float) -> None:
+    """BENCH_CP_KILL=1: SIGKILL the service process once every node has
+    committed a batch; the run keeps going on the clients' local
+    fallbacks and the JSON line records the flip."""
+
+    def _watch():
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            svc = cluster.crypto_service
+            if svc is None or not getattr(svc, "alive", False):
+                return
+            counts = [cluster.batch_count(i) for i in cluster.nodes]
+            if counts and min(counts) >= 1:
+                try:
+                    kill_info["stats_at_kill"] = {
+                        k: v
+                        for k, v in svc.stats()["counters"].items()
+                        if k.startswith("crypto.")
+                    }
+                except Exception:
+                    pass
+                svc.kill()
+                kill_info["killed"] = True
+                kill_info["killed_at_epoch"] = min(counts)
+                return
+            time.sleep(0.05)
+
+    threading.Thread(target=_watch, daemon=True).start()
 
 
 def run_one(
@@ -121,6 +198,11 @@ def run_one(
     }
     cluster = build_cluster(n, arm, impl, seed, window_s)
     d = TrafficDriver(cluster, fleet)
+    kill_info: dict = {}
+    kill_armed = (
+        os.environ.get("BENCH_CP_KILL") == "1"
+        and arm.startswith("service-proc")
+    )
     try:
         obs_port = os.environ.get("BENCH_OBS_PORT")
         if obs_port is not None:
@@ -130,6 +212,8 @@ def run_one(
             rec["presubmitted"] = len(ids)
             t0 = time.perf_counter()
             cluster.start()
+            if kill_armed:
+                arm_kill_drill(cluster, kill_info, deadline_s)
             drained = d.drain(deadline_s)
             wall = time.perf_counter() - t0
             res = {
@@ -147,6 +231,8 @@ def run_one(
             rec["drained"] = drained
         else:
             cluster.start()
+            if kill_armed:
+                arm_kill_drill(cluster, kill_info, deadline_s)
             res = d.run_open_loop(duration_s, drain_timeout_s=deadline_s)
             wall = res["wall_s"]
         epochs = min(cluster.batch_count(i) for i in cluster.nodes)
@@ -156,7 +242,7 @@ def run_one(
             {
                 "wall_s": round(wall, 2),
                 "epochs_committed": epochs,
-                "epochs_per_s": round(epochs / wall, 3) if wall else None,
+                "epochs_per_s": round(epochs / wall, 5) if wall else None,
                 "committed_txns": res["committed"],
                 "txns_per_s": round(res["committed"] / wall, 1)
                 if wall
@@ -183,6 +269,46 @@ def run_one(
         if t is not None:
             rec["crypto"]["flush_mean_s"] = round(t.mean_s, 5)
             rec["crypto"]["flush_max_s"] = round(t.max_s, 5)
+        if arm.startswith("service-proc"):
+            # RPC-boundary columns: client side from the merged node
+            # metrics, service side from the worker's stats RPC (its
+            # counters die with the process, so a killed service only
+            # reports what the drill snapshotted)
+            rec["crypto"]["rpc"] = {
+                k: m.counters.get(f"crypto.rpc.{k}", 0)
+                for k in (
+                    "calls", "requests", "merged_requests", "merged_jobs",
+                    "fallbacks", "fallback_requests", "connects",
+                    "reconnects",
+                )
+            }
+            rt = m.timers.get("crypto.rpc.round_trip")
+            if rt is not None:
+                rec["crypto"]["rpc"]["round_trip_mean_s"] = round(
+                    rt.mean_s, 5
+                )
+            svc = cluster.crypto_service
+            if svc is not None and getattr(svc, "alive", False):
+                try:
+                    rec["crypto"]["service"] = {
+                        k: v
+                        for k, v in svc.stats()["counters"].items()
+                        if k.startswith("crypto.")
+                    }
+                except Exception:
+                    pass
+            if kill_armed:
+                rec["kill_drill"] = {
+                    "killed": kill_info.get("killed", False),
+                    "killed_at_epoch": kill_info.get("killed_at_epoch"),
+                    "epochs_after_kill": (
+                        epochs - kill_info["killed_at_epoch"]
+                        if "killed_at_epoch" in kill_info
+                        else None
+                    ),
+                    "fallbacks": m.counters.get("crypto.rpc.fallbacks", 0),
+                    "stats_at_kill": kill_info.get("stats_at_kill"),
+                }
         if os.environ.get("BENCH_CP_METRICS"):
             rec["metrics"] = m.to_json()
         obs_extras(rec, cluster, f"config9_n{n}_{arm}_{impl}", m=m)
@@ -218,7 +344,10 @@ def main() -> None:
     for n in ns:
         tps = float(tps_env) if tps_env else 80.0 / (n * n)
         for arm in arms:
-            arm_impls = ["python"] if arm == "service-tpu" else impls
+            if arm in ("service-tpu", "inline-bls", "service-proc-bls"):
+                arm_impls = ["python"]  # BLS suite: python nodes only
+            else:
+                arm_impls = impls
             for impl in arm_impls:
                 rec = run_one(
                     n, arm, impl, drive=drive, duration_s=duration,

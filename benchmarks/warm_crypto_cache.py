@@ -156,6 +156,40 @@ def main() -> None:
     )
     svc.stop()
     log(f"cryptoplane service flush warmed in {time.time() - t0:.0f}s")
+
+    # Service-PROCESS arm (round 18): WARM_SERVICE_LEGS=1 spawns the
+    # RPC worker with the TpuBackend and pushes the same mixed-kind
+    # batch through the socket, so the WORKER's own .jax_cache entries
+    # (config9's service-proc-bls BLS/TPU arm) get built now instead of
+    # on first cluster traffic.  The worker inherits this process's
+    # JAX_PLATFORMS/HBBFT_TPU_JAX_CACHE via force_cpu_jax=False — run
+    # this under the same env the deployment will use.
+    if os.environ.get("WARM_SERVICE_LEGS"):
+        from hbbft_tpu.cryptoplane.proc_service import (
+            RpcServiceClient,
+            ServiceProcess,
+        )
+
+        t0 = time.time()
+        with ServiceProcess(
+            suite="bls", backend="tpu", force_cpu_jax=False,
+            ready_timeout_s=600.0,
+        ) as proc:
+            rpc = RpcServiceClient(
+                proc.addr, suite, BatchedBackend(suite), timeout_s=3600.0
+            )
+            ok = rpc.verify_batch(batches[8])
+            assert all(ok)
+            assert rpc.metrics.counters.get("crypto.rpc.fallbacks", 0) == 0, (
+                rpc.metrics.counters
+            )
+            stats = proc.stats()["counters"]
+            assert stats.get("crypto.flushes", 0) == 1, stats
+            rpc.close()
+        log(
+            "service-process (rpc) flush warmed in "
+            f"{time.time() - t0:.0f}s"
+        )
     log("done")
 
 

@@ -1,9 +1,23 @@
 """Cluster crypto plane (ISSUE 12): the shared batched
 share-verification service behind :class:`~hbbft_tpu.crypto.backend.
-CryptoBackend`, serving both cluster node arms.  See
-docs/CRYPTO_PLANE.md and :mod:`hbbft_tpu.cryptoplane.service`.
+CryptoBackend`, serving both cluster node arms.  Round 18 adds the
+process form — the service in its own OS process behind a socket RPC
+boundary (:mod:`hbbft_tpu.cryptoplane.proc_service`), serving
+process-per-node clusters and cross-node-batching onto one accelerator
+backend.  See docs/CRYPTO_PLANE.md.
 """
 
 from hbbft_tpu.cryptoplane.service import CryptoPlaneService, ServiceClient
+from hbbft_tpu.cryptoplane.proc_service import (
+    CryptoRpcServer,
+    RpcServiceClient,
+    ServiceProcess,
+)
 
-__all__ = ["CryptoPlaneService", "ServiceClient"]
+__all__ = [
+    "CryptoPlaneService",
+    "ServiceClient",
+    "CryptoRpcServer",
+    "RpcServiceClient",
+    "ServiceProcess",
+]

@@ -53,12 +53,19 @@ from hbbft_tpu.utils.metrics import Metrics
 class _Job:
     """One client's submitted batch: requests in, verdicts out."""
 
-    __slots__ = ("reqs", "results", "done", "cancelled")
+    __slots__ = ("reqs", "results", "done", "cancelled",
+                 "flush_requests", "flush_jobs")
 
     def __init__(self, reqs: List[VerifyRequest]) -> None:
         self.reqs = reqs
         self.results: Optional[List[bool]] = None  # None = failed/killed
         self.done = threading.Event()
+        # Stamped by _flush: the size of the MERGED batch this job rode
+        # in (requests / jobs across all clients) — the cross-node
+        # amortization observable the RPC server reports back to its
+        # clients (proc_service.py).
+        self.flush_requests = 0
+        self.flush_jobs = 0
         # Set by a client that timed out and re-verified locally: the
         # worker drops still-queued cancelled jobs instead of paying a
         # backend flush nobody is waiting for (best-effort — a job the
@@ -252,6 +259,8 @@ class CryptoPlaneService:
                     ok=ok,
                 )
             for j in jobs:
+                j.flush_requests = len(reqs)
+                j.flush_jobs = len(jobs)
                 j.done.set()
 
     def _publish_batch_summary(self) -> None:

@@ -257,17 +257,35 @@ def critical_path(
 def _flush_spans(
     tracks: Dict[str, List[TraceEvent]]
 ) -> List[Tuple[float, float]]:
-    """(t_open, t_done) per crypto-plane flush, paired in emit order
-    (the service flushes sequentially on its own thread)."""
+    """(t_open, t_done) per crypto-plane flush.
+
+    Events carrying a ``span`` id pair by id: RPC-mode clients
+    (proc_service.py) share one ``cryptoplane`` buffer and flush
+    CONCURRENTLY, so their open/done events interleave.  Spanless
+    events (the in-thread service flushes sequentially on its own
+    worker) keep the emit-order pairing.  Spans are returned sorted by
+    open time so the per-epoch window filter sees one timeline.
+    """
     evs = tracks.get("cryptoplane") or []
     spans: List[Tuple[float, float]] = []
     open_t: Optional[float] = None
+    open_by_span: Dict[Any, float] = {}
     for ev in evs:
+        span = ev.args.get("span")
         if ev.name == "crypto.flush.open":
-            open_t = ev.ts
-        elif ev.name == "crypto.flush.done" and open_t is not None:
-            spans.append((open_t, ev.ts))
-            open_t = None
+            if span is not None:
+                open_by_span[span] = ev.ts
+            else:
+                open_t = ev.ts
+        elif ev.name == "crypto.flush.done":
+            if span is not None:
+                t0 = open_by_span.pop(span, None)
+                if t0 is not None:
+                    spans.append((t0, ev.ts))
+            elif open_t is not None:
+                spans.append((open_t, ev.ts))
+                open_t = None
+    spans.sort()
     return spans
 
 

@@ -476,16 +476,28 @@ class LocalCluster:
         # TpuBackend) is passed in; every node keeps a local
         # BatchedBackend fallback, so a dead/slow service degrades to
         # inline verification instead of stalling the cluster.
-        if crypto not in ("inline", "service"):
+        # "service-proc" (round 18): the same service in its own OS
+        # process behind the socket RPC boundary
+        # (hbbft_tpu/cryptoplane/proc_service.py).  crypto_service may
+        # be a pre-started ServiceProcess, a (host, port) address of an
+        # externally-run worker, or None — None consults
+        # HBBFT_TPU_CRYPTO_SERVICE and otherwise spawns an owned worker
+        # (Batched backend over this cluster's suite).  Per-node
+        # RpcServiceClients keep the local-BatchedBackend fallback, so
+        # a killed service process degrades to inline verification.
+        if crypto not in ("inline", "service", "service-proc"):
             raise ValueError(
-                f"unknown crypto arm {crypto!r} (inline | service)"
+                f"unknown crypto arm {crypto!r} "
+                "(inline | service | service-proc)"
             )
-        if crypto_service is not None and crypto != "service":
-            raise ValueError("crypto_service requires crypto='service'")
+        if crypto_service is not None and crypto == "inline":
+            raise ValueError("crypto_service requires a service crypto arm")
         self.crypto = crypto
         self.crypto_service = crypto_service
         self._owns_service = False
         self._service_timeout_s = 30.0
+        self._service_addr: Optional[Tuple[str, int]] = None
+        self._cryptoplane_trace: Optional[TraceBuffer] = None
         if crypto == "service":
             from hbbft_tpu.cryptoplane import CryptoPlaneService
 
@@ -507,8 +519,57 @@ class LocalCluster:
                     "pre-built crypto_service (only timeout_s, which "
                     "configures the per-node clients)"
                 )
+        elif crypto == "service-proc":
+            from hbbft_tpu.cryptoplane.proc_service import (
+                ServiceProcess,
+                default_rpc_timeout_s,
+                service_addr_from_env,
+                suite_arg_for,
+            )
+
+            kw = dict(service_kwargs or {})
+            self._service_timeout_s = float(
+                kw.pop("timeout_s", default_rpc_timeout_s())
+            )
+            # one client-side span ring for all nodes: RPC flush spans
+            # carry per-client span ids, so the analyzer can pair them
+            # even though clients flush concurrently
+            self._cryptoplane_trace = TraceBuffer("cryptoplane")
+            if isinstance(self.crypto_service, tuple):
+                if kw:
+                    raise ValueError(
+                        f"service_kwargs {sorted(kw)} cannot be applied "
+                        "to an externally-run crypto service address"
+                    )
+                self._service_addr = self.crypto_service
+                self.crypto_service = None
+            elif self.crypto_service is not None:
+                if kw:
+                    raise ValueError(
+                        f"service_kwargs {sorted(kw)} cannot be applied "
+                        "to a pre-started crypto_service process"
+                    )
+                self._service_addr = self.crypto_service.addr
+            else:
+                env_addr = service_addr_from_env()
+                if env_addr is not None:
+                    if kw:
+                        raise ValueError(
+                            f"service_kwargs {sorted(kw)} cannot be "
+                            "applied to the HBBFT_TPU_CRYPTO_SERVICE "
+                            "external service"
+                        )
+                    self._service_addr = env_addr
+                else:
+                    self.crypto_service = ServiceProcess(
+                        suite=suite_arg_for(self.suite),
+                        backend=kw.pop("backend", "batched"),
+                        **kw,
+                    ).start()
+                    self._owns_service = True
+                    self._service_addr = self.crypto_service.addr
         elif service_kwargs:
-            raise ValueError("service_kwargs requires crypto='service'")
+            raise ValueError("service_kwargs requires a service crypto arm")
         self._transport_kwargs: Dict[str, Any] = dict(
             max_queue_frames=max_queue_frames,
         )
@@ -547,11 +608,26 @@ class LocalCluster:
     def honest_ids(self) -> List[int]:
         return [i for i in range(self.n) if i not in self.byzantine]
 
-    def _service_client(self):
+    def _service_client(self, i: int, t: TcpTransport):
         """A fresh per-node facade onto the shared verification service
         (each carries its own local-CPU fallback backend; restart()
         re-enters here, so a reborn node gets a live client even after
-        drills killed its predecessor mid-wait)."""
+        drills killed its predecessor mid-wait).  In RPC mode the
+        client writes ``crypto.rpc.*`` into the node's transport
+        metrics — the path every merge/scrape already walks — and its
+        flush spans onto the shared ``cryptoplane`` ring."""
+        if self.crypto == "service-proc":
+            from hbbft_tpu.cryptoplane.proc_service import RpcServiceClient
+
+            return RpcServiceClient(
+                self._service_addr,
+                self.suite,
+                BatchedBackend(self.suite),
+                timeout_s=self._service_timeout_s,
+                metrics=t.metrics,
+                trace=self._cryptoplane_trace,
+                client_id=f"node{i}",
+            )
         return self.crypto_service.client(
             BatchedBackend(self.suite), timeout_s=self._service_timeout_s
         )
@@ -559,7 +635,7 @@ class LocalCluster:
     def _make_node(self, i: int, t: TcpTransport):
         netinfo = build_netinfo(self.n, self.f, self.seed, self.suite, i)
         t.tracer = self.traces[i]  # transport milestones share the ring
-        service = self.crypto == "service"
+        service = self.crypto in ("service", "service-proc")
         if self._impl_for(i) == "native":
             from hbbft_tpu.transport.native_node import NativeClusterNode
 
@@ -573,7 +649,7 @@ class LocalCluster:
                 batch_size=self._batch_size,
                 session_id=self._session_id,
                 trace=self.traces[i],
-                crypto_backend=self._service_client() if service else None,
+                crypto_backend=self._service_client(i, t) if service else None,
             )
         else:
             node = ClusterNode(
@@ -582,7 +658,7 @@ class LocalCluster:
                 all_ids=list(range(self.n)),
                 transport=t,
                 backend=(
-                    self._service_client()
+                    self._service_client(i, t)
                     if service
                     else self._backend_factory(self.suite)
                 ),
@@ -783,9 +859,16 @@ class LocalCluster:
             # injected-fault totals land in the same Prometheus dump as
             # the transport/cluster counters (faults.* gauges)
             self.injector.export_metrics(m)
-        if self.crypto_service is not None:
+        if self.crypto_service is not None and hasattr(
+            self.crypto_service, "export_metrics"
+        ):
             # crypto.* service plane (round 13): flush count/latency,
-            # batch-size summary, queue depth, fallback totals
+            # batch-size summary, queue depth, fallback totals.  The
+            # RPC-mode ServiceProcess has no in-process metrics to
+            # merge — its clients' crypto.rpc.* counters already ride
+            # the per-node transport metrics merged above, and the
+            # service process's own counters come back through its
+            # stats RPC (config9 queries it directly).
             self.crypto_service.export_metrics(m)
         return m
 
@@ -798,7 +881,11 @@ class LocalCluster:
         cluster_events = self.trace.snapshot()
         if cluster_events:
             out[self.trace.track] = cluster_events
+        # in-thread service: the service's own ring; RPC mode: the
+        # cluster-held ring the per-node clients' flush spans land on
         svc_trace = getattr(self.crypto_service, "trace", None)
+        if svc_trace is None:
+            svc_trace = self._cryptoplane_trace
         if svc_trace is not None:
             svc_events = svc_trace.snapshot()
             if svc_events:

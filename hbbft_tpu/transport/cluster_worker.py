@@ -83,6 +83,7 @@ class _SoloClusterView:
         node: Any,
         trace: TraceBuffer,
         consensus_n: Optional[int] = None,
+        crypto_trace: Optional[TraceBuffer] = None,
     ) -> None:
         self.node_id = node_id
         self.nodes = {node_id: node}
@@ -93,6 +94,11 @@ class _SoloClusterView:
         self.consensus_n = consensus_n
         self.byzantine: Dict[int, Any] = {}
         self.trace = trace
+        # RPC crypto-plane mode (round 18): this node's flush spans
+        # ride their own "cryptoplane" ring so the analyzer's flush
+        # attribution works per worker (and survives the parent-side
+        # Chrome-trace merge as its own track).
+        self.crypto_trace = crypto_trace
         # Same 2 s phase-summary TTL cache as LocalCluster: a polling
         # scraper must not re-pay the ring walk + quantile sort per
         # request (a parent drill polls /metrics many times a second
@@ -107,7 +113,12 @@ class _SoloClusterView:
 
     def trace_events(self) -> Dict[str, list]:
         events = self.trace.snapshot()
-        return {self.trace.track: events} if events else {}
+        out = {self.trace.track: events} if events else {}
+        if self.crypto_trace is not None:
+            cp = self.crypto_trace.snapshot()
+            if cp:
+                out[self.crypto_trace.track] = cp
+        return out
 
     def merged_metrics(self, fresh: bool = False) -> Any:
         now = time.monotonic()
@@ -216,6 +227,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="embed the full metrics JSON in the summary line",
     )
+    ap.add_argument(
+        "--crypto-service",
+        default=None,
+        help="host:port of a crypto-plane service process "
+        "(hbbft_tpu.cryptoplane.proc_service); this node's share checks "
+        "route there with a local-BatchedBackend fallback",
+    )
+    ap.add_argument(
+        "--crypto-timeout-s",
+        type=float,
+        default=None,
+        help="RPC round-trip budget before a flush falls back locally "
+        "(default HBBFT_TPU_CRYPTO_RPC_TIMEOUT_S)",
+    )
     args = ap.parse_args(argv)
 
     n = args.n
@@ -244,6 +269,29 @@ def main(argv=None) -> int:
     trace = TraceBuffer(f"node{node_id}")
     transport.tracer = trace
 
+    crypto_trace: Optional[TraceBuffer] = None
+    crypto_backend: Any = None
+    if args.crypto_service is not None:
+        # Round 18: route this node's share checks through the crypto
+        # service process.  Metrics land on the transport's Metrics (the
+        # object merge_node_metrics already walks), spans on their own
+        # cryptoplane ring; verdict purity makes the fallback safe.
+        from hbbft_tpu.cryptoplane.proc_service import (
+            RpcServiceClient,
+            parse_addr,
+        )
+
+        crypto_trace = TraceBuffer("cryptoplane")
+        crypto_backend = RpcServiceClient(
+            parse_addr(args.crypto_service),
+            suite,
+            BatchedBackend(suite),
+            timeout_s=args.crypto_timeout_s,
+            metrics=transport.metrics,
+            trace=crypto_trace,
+            client_id=f"node{node_id}",
+        )
+
     netinfo = build_netinfo(n, f, args.seed, suite, node_id)
     if args.impl == "native":
         from hbbft_tpu.transport.native_node import NativeClusterNode
@@ -258,6 +306,7 @@ def main(argv=None) -> int:
             batch_size=args.batch_size,
             session_id=args.session_id.encode(),
             trace=trace,
+            crypto_backend=crypto_backend,
         )
     else:
         node = ClusterNode(
@@ -265,7 +314,11 @@ def main(argv=None) -> int:
             netinfo=netinfo,
             all_ids=list(range(n)),
             transport=transport,
-            backend=BatchedBackend(suite),
+            backend=(
+                crypto_backend
+                if crypto_backend is not None
+                else BatchedBackend(suite)
+            ),
             suite=suite,
             seed=args.seed,
             protocol_factory=_default_protocol_factory(
@@ -274,7 +327,9 @@ def main(argv=None) -> int:
             trace=trace,
         )
 
-    view = _SoloClusterView(node_id, node, trace, consensus_n=n)
+    view = _SoloClusterView(
+        node_id, node, trace, consensus_n=n, crypto_trace=crypto_trace
+    )
     obs_server = None
     obs_port: Optional[int] = None
     if args.obs_port is not None:
@@ -387,6 +442,22 @@ def main(argv=None) -> int:
             "trace_dropped": int(m.gauges.get("trace.dropped", 0)),
             "wall_s": round(wall, 3),
         }
+        if args.crypto_service is not None:
+            # the crypto-plane RPC story in one glance: how many flushes
+            # rode the service vs fell back locally (the kill drill's
+            # fallback flip shows up here)
+            summary["crypto_rpc"] = {
+                "calls": m.counters.get("crypto.rpc.calls", 0),
+                "requests": m.counters.get("crypto.rpc.requests", 0),
+                "fallbacks": m.counters.get("crypto.rpc.fallbacks", 0),
+                "fallback_requests": m.counters.get(
+                    "crypto.rpc.fallback_requests", 0
+                ),
+                "reconnects": m.counters.get("crypto.rpc.reconnects", 0),
+                "merged_requests": m.counters.get(
+                    "crypto.rpc.merged_requests", 0
+                ),
+            }
         if args.metrics:
             summary["metrics"] = m.to_json()
         print(json.dumps(summary, sort_keys=True), flush=True)

@@ -76,6 +76,18 @@ KIND_ACK = 0x03
 
 _KINDS = (KIND_HELLO, KIND_MSG, KIND_ACK)
 
+#: Crypto-plane RPC kinds (hbbft_tpu.cryptoplane.proc_service).  They
+#: share the frame grammar (same length/CRC slicing, same caps) but are
+#: a DISJOINT kind set passed explicitly via ``kinds=`` — the consensus
+#: transport keeps rejecting them, so a crypto-service socket
+#: accidentally pointed at a node port (or vice versa) dies at the
+#: framing layer instead of smuggling frames across trust boundaries.
+KIND_CRYPTO_HELLO = 0x21
+KIND_CRYPTO_REQ = 0x22
+KIND_CRYPTO_RESP = 0x23
+
+CRYPTO_KINDS = (KIND_CRYPTO_HELLO, KIND_CRYPTO_REQ, KIND_CRYPTO_RESP)
+
 
 def encode_ack(count: int) -> bytes:
     """Cumulative-consumed ACK frame (fixed 17 bytes on the wire)."""
@@ -96,11 +108,18 @@ class FrameError(ValueError):
     """Malformed, oversized, corrupted, or version-mismatched frame."""
 
 
-def encode_frame(kind: int, payload: bytes, max_frame_len: int = MAX_FRAME_LEN) -> bytes:
+def encode_frame(
+    kind: int,
+    payload: bytes,
+    max_frame_len: int = MAX_FRAME_LEN,
+    kinds: Tuple[int, ...] = _KINDS,
+) -> bytes:
     """One wire frame.  Raises :class:`FrameError` if the frame would
     exceed ``max_frame_len`` (the local cap: never emit what a peer
-    honoring the same limits would have to reject)."""
-    if kind not in _KINDS:
+    honoring the same limits would have to reject).  ``kinds`` is the
+    plane's accepted kind set (transport default; the crypto-plane RPC
+    passes :data:`CRYPTO_KINDS`)."""
+    if kind not in kinds:
         raise FrameError(f"unknown frame kind 0x{kind:02x}")
     length = 1 + len(payload)
     if length > max_frame_len:
@@ -124,10 +143,15 @@ class FrameDecoder:
     no recoverable sync point) — callers drop the connection.
     """
 
-    __slots__ = ("max_frame_len", "_buf", "_poisoned")
+    __slots__ = ("max_frame_len", "kinds", "_buf", "_poisoned")
 
-    def __init__(self, max_frame_len: int = MAX_FRAME_LEN) -> None:
+    def __init__(
+        self,
+        max_frame_len: int = MAX_FRAME_LEN,
+        kinds: Tuple[int, ...] = _KINDS,
+    ) -> None:
         self.max_frame_len = max_frame_len
+        self.kinds = kinds
         self._buf = bytearray()
         self._poisoned = False
 
@@ -159,7 +183,7 @@ class FrameDecoder:
             self._poisoned = True
             raise FrameError("frame CRC mismatch (channel corruption)")
         kind = body[0]
-        if kind not in _KINDS:
+        if kind not in self.kinds:
             self._poisoned = True
             raise FrameError(f"unknown frame kind 0x{kind:02x}")
         del buf[: _HDR_BYTES + length]

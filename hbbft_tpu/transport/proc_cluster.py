@@ -142,11 +142,37 @@ class ProcCluster:
         ready_timeout_s: Optional[float] = None,
         stderr: str = "devnull",
         python: str = sys.executable,
+        crypto: str = "inline",
+        crypto_service: Any = None,
+        service_kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
         if impl not in ("python", "native"):
             raise ValueError(f"impl must be python|native, got {impl!r}")
         if drive not in ("presubmit", "self"):
             raise ValueError(f"drive must be presubmit|self, got {drive!r}")
+        # crypto (round 18): "service-proc" points every worker at ONE
+        # crypto-plane service process (--crypto-service host:port), so
+        # all N node processes' share checks batch through one backend
+        # flush — the cross-node amortization plane ProcCluster could
+        # not reach with the round-13 in-thread service.  crypto_service
+        # may be a pre-started ServiceProcess or a (host, port) tuple;
+        # None spawns an owned worker (or attaches to
+        # HBBFT_TPU_CRYPTO_SERVICE).  Workers keep local fallbacks —
+        # killing the service process never stalls the cluster.
+        if crypto not in ("inline", "service-proc"):
+            raise ValueError(
+                f"crypto must be inline|service-proc, got {crypto!r}"
+            )
+        if crypto_service is not None and crypto != "service-proc":
+            raise ValueError("crypto_service requires crypto='service-proc'")
+        if service_kwargs and crypto != "service-proc":
+            raise ValueError("service_kwargs requires crypto='service-proc'")
+        self.crypto = crypto
+        self.crypto_service = crypto_service
+        self._service_kwargs = dict(service_kwargs or {})
+        self._crypto_timeout_s = self._service_kwargs.pop("timeout_s", None)
+        self._owns_service = False
+        self._service_addr: Optional[Tuple[str, int]] = None
         self.n = n
         self.seed = seed
         self.batch_size = batch_size
@@ -204,6 +230,13 @@ class ProcCluster:
                 "--trace-file",
                 os.path.join(self.trace_dir, f"node{node_id}.trace.json"),
             ]
+        if self._service_addr is not None:
+            cmd += [
+                "--crypto-service",
+                f"{self._service_addr[0]}:{self._service_addr[1]}",
+            ]
+            if self._crypto_timeout_s is not None:
+                cmd += ["--crypto-timeout-s", str(self._crypto_timeout_s)]
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -222,8 +255,53 @@ class ProcCluster:
         )
         return _Worker(node_id, proc)
 
+    def _resolve_service(self) -> None:
+        """Resolve the crypto-service address BEFORE any worker spawns
+        (the address rides each worker's argv)."""
+        if self.crypto != "service-proc" or self._service_addr is not None:
+            return
+        from hbbft_tpu.cryptoplane.proc_service import (
+            ServiceProcess,
+            service_addr_from_env,
+        )
+
+        if isinstance(self.crypto_service, tuple):
+            self._service_addr = self.crypto_service
+            self.crypto_service = None
+            return
+        if self.crypto_service is not None:
+            self._service_addr = self.crypto_service.addr
+            return
+        env_addr = service_addr_from_env()
+        if env_addr is not None:
+            self._service_addr = env_addr
+            return
+        self.crypto_service = ServiceProcess(
+            suite="scalar",
+            backend=self._service_kwargs.pop("backend", "batched"),
+            python=self.python,
+            **self._service_kwargs,
+        ).start()
+        self._owns_service = True
+        self._service_addr = self.crypto_service.addr
+
+    def kill_service(self) -> None:
+        """SIGKILL the crypto-service process mid-run (the fallback
+        drill): workers' flushes fall back locally, commits continue."""
+        if self.crypto_service is None:
+            raise RuntimeError("no crypto-service process to kill")
+        self.crypto_service.kill()
+
+    def restart_service(self) -> None:
+        """Respawn the killed service on its old port; workers'
+        bounded-backoff re-dials re-attach automatically."""
+        if self.crypto_service is None:
+            raise RuntimeError("no crypto-service process to restart")
+        self.crypto_service.restart()
+
     def start(self) -> "ProcCluster":
         assert not self._started
+        self._resolve_service()
         for i in range(self.n):
             self.workers[i] = self._spawn(i)
         deadline = time.monotonic() + self.ready_timeout_s
@@ -389,6 +467,12 @@ class ProcCluster:
                 w.proc.kill()
                 w.proc.wait(timeout=5)
             w.thread.join(timeout=5)
+        # Service AFTER the workers (same ordering rule as
+        # LocalCluster.stop): in-flight flushes drain or fall back
+        # before the plane goes away.  Only a service THIS cluster
+        # spawned — an externally-run one belongs to its owner.
+        if self._owns_service and self.crypto_service is not None:
+            self.crypto_service.stop()
         self._started = False
 
     def __enter__(self) -> "ProcCluster":

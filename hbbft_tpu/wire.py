@@ -62,10 +62,17 @@ from __future__ import annotations
 
 from typing import Any
 
+from hbbft_tpu.crypto.backend import (
+    CIPHERTEXT,
+    DEC_SHARE,
+    SIG_SHARE,
+    VerifyRequest,
+)
 from hbbft_tpu.crypto.keys import (
     Ciphertext,
     DecryptionShare,
     PublicKey,
+    PublicKeyShare,
     Signature,
     SignatureShare,
 )
@@ -311,6 +318,53 @@ def _unpack_bivar_commitment(f: tuple) -> BivarCommitment:
         "BivarCommitment: mixed/bad element types",
     )
     return BivarCommitment(elems)
+
+
+# -- crypto-plane RPC -------------------------------------------------------
+
+
+def _pack_verify_request(r: VerifyRequest) -> tuple:
+    # Opaque-to-the-engine RPC payload (cryptoplane/proc_service.py).
+    # The public-key share rides as its bare G1 element: the share (or
+    # ciphertext) in the same tuple pins the suite in-band, so unpack
+    # reconstructs PublicKeyShare without a separate registered type.
+    if r.kind == SIG_SHARE:
+        pk, msg, share = r.payload
+        return (r.kind, pk.g1, msg, share)
+    if r.kind == DEC_SHARE:
+        pk, ct, share = r.payload
+        return (r.kind, pk.g1, ct, share)
+    (ct,) = r.payload
+    return (r.kind, ct)
+
+
+def _unpack_verify_request(f: tuple) -> VerifyRequest:
+    _need(len(f) >= 1, "VerifyRequest: empty")
+    kind = f[0]
+    if kind == SIG_SHARE:
+        _, g1, msg, share = _fields(f, 4, "VerifyRequest[sig]")
+        _need(isinstance(share, SignatureShare), "VerifyRequest: bad share")
+        suite = share.suite
+        return VerifyRequest.sig_share(
+            PublicKeyShare(_g1(suite, g1, "VerifyRequest.pk"), suite),
+            _bytes(msg, "VerifyRequest.msg"),
+            share,
+        )
+    if kind == DEC_SHARE:
+        _, g1, ct, share = _fields(f, 4, "VerifyRequest[dec]")
+        _need(isinstance(ct, Ciphertext), "VerifyRequest: bad ciphertext")
+        _need(isinstance(share, DecryptionShare), "VerifyRequest: bad share")
+        suite = share.suite
+        return VerifyRequest.dec_share(
+            PublicKeyShare(_g1(suite, g1, "VerifyRequest.pk"), suite),
+            ct,
+            share,
+        )
+    if kind == CIPHERTEXT:
+        _, ct = _fields(f, 2, "VerifyRequest[ct]")
+        _need(isinstance(ct, Ciphertext), "VerifyRequest: bad ciphertext")
+        return VerifyRequest.ciphertext(ct)
+    raise DecodeError("VerifyRequest: bad kind")
 
 
 # -- honey badger -----------------------------------------------------------
@@ -767,6 +821,13 @@ register_struct("part", Part, _pack_part, _unpack_part)
 # lint: wire-oneside (DKG Ack rides inside key-gen contribution
 #     payloads the engine never parses)
 register_struct("ack", Ack, _pack_ack, _unpack_ack)
+# Crypto-plane RPC payloads only: the service process boundary of
+# cryptoplane/proc_service.py.  The engine wire codec never carries
+# verification requests (native nodes hand an attached ext backend
+# fully-decoded request objects), so this tag is Python-side by design.
+# lint: wire-oneside (crypto-plane RPC only; engine codec never
+#     carries verification requests)
+register_struct("vreq", VerifyRequest, _pack_verify_request, _unpack_verify_request)
 
 # transport-boundary (live wire) types
 register_struct("sigshare", SignatureShare, _pack_sig_share, _unpack_sig_share)

@@ -215,7 +215,8 @@ def test_cluster_does_not_stop_external_service():
             4, seed=1, crypto="service", crypto_service=svc,
             service_kwargs=dict(window_s=0.5),
         )
-    with pytest.raises(ValueError, match="requires crypto='service'"):
+    # message covers both service arms since round 18
+    with pytest.raises(ValueError, match="requires a service crypto arm"):
         LocalCluster(4, seed=1, crypto_service=svc)
 
 

@@ -454,6 +454,10 @@ SUBGROUP_ENTRY_NAMES = {"g1_from_bytes", "g2_from_bytes", "verify_batch"}
 POINT_STRUCT_TAGS = {
     "ct", "sig", "pk", "comm", "bicomm", "change", "svote", "skg",
     "icontrib", "joinplan", "part", "ack",
+    # crypto-plane RPC: the pk share's bare G1 plus nested share/ct
+    # structs (each re-checked by its own unpacker; the bare G1 goes
+    # through _g1's subgroup check in _unpack_verify_request)
+    "vreq",
     # transport-boundary live-message tree (group elements ride in the
     # share leaves; envelopes delegate via isinstance of nested types)
     "sigshare", "decshare", "signmsg", "decmsg", "ba_coin", "ba",
