@@ -52,6 +52,15 @@ wall clock.  The vectored-egress A/B for any arm is
 ``HBBFT_TPU_SENDMSG=0`` (buffered round-9 path) vs unset (sendmsg
 gather egress) on the same build; every line records the live setting.
 
+Round 20 — message coalescing: every line records the live ``coalesce``
+arm (``HBBFT_TPU_COALESCE``; see docs/TRANSPORT.md "Message
+coalescing") plus ``msgs_sent`` and ``msgs_per_frame`` (the coalescing
+ratio — 1.0 on the per-message arm).  ``BENCH_TCP_COALESCE_AB=1`` runs
+BOTH arms back to back per N on one build (thread arms only), printing
+one line each and asserting the two ``batches_sha`` digests are
+identical in presubmit drive — the batching-never-changes-semantics
+pin, benchmarked.
+
 Round 16: every line carries the analyzer's ``critical_path`` summary
 (per-epoch critical path to commit, straggler attribution, phase share
 of wall, cross-node skew, BA rounds — docs/OBSERVABILITY.md "Critical
@@ -77,7 +86,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hbbft_tpu.protocols.queueing_honey_badger import Input  # noqa: E402
 from hbbft_tpu.transport import LocalCluster  # noqa: E402
-from hbbft_tpu.transport.transport import _sendmsg_default  # noqa: E402
+from hbbft_tpu.transport.transport import (  # noqa: E402
+    _coalesce_default,
+    _sendmsg_default,
+)
 from hbbft_tpu.utils import serde  # noqa: E402
 
 
@@ -229,6 +241,9 @@ def run_n_proc(
         "workers": n,
         "threads_per_node": 3,  # selector loop + engine sweep + driver
         "vectored": _sendmsg_default(),
+        # workers inherit the environment, so the env default names
+        # the proc arm too (HBBFT_TPU_COALESCE)
+        "coalesce": _coalesce_default(),
         "target_epochs": epochs,
     }
     rec.update(_engine_build_fields(n))
@@ -292,11 +307,20 @@ def run_n_proc(
 
 
 def run_n(
-    n: int, epochs: int, deadline_s: float, impl: str, drive: str, seed: int
+    n: int,
+    epochs: int,
+    deadline_s: float,
+    impl: str,
+    drive: str,
+    seed: int,
+    coalesce: bool = None,
 ) -> dict:
     t0 = time.perf_counter()
+    kwargs = {}
+    if coalesce is not None:  # the BENCH_TCP_COALESCE_AB dual-arm driver
+        kwargs["transport_kwargs"] = {"coalesce": coalesce}
     cluster = LocalCluster(
-        n, seed=seed, batch_size=8, node_impl=resolve_impl(impl, n)
+        n, seed=seed, batch_size=8, node_impl=resolve_impl(impl, n), **kwargs
     )
     setup_s = time.perf_counter() - t0
     rec = {
@@ -310,6 +334,7 @@ def run_n(
         "serde_native": serde._native_scan(serde.dumps(0)) is not None,
         "threads_per_node": 2,
         "vectored": _sendmsg_default(),
+        "coalesce": _coalesce_default() if coalesce is None else coalesce,
         "target_epochs": epochs,
         "setup_s": round(setup_s, 3),
     }
@@ -352,6 +377,11 @@ def run_n(
             for node in cluster.nodes.values()
             for st in node.transport.stats().values()
         )
+        msgs_sent = sum(
+            st["msgs_out"]
+            for node in cluster.nodes.values()
+            for st in node.transport.stats().values()
+        )
         wire_bytes = sum(
             st["bytes_out"]
             for node in cluster.nodes.values()
@@ -367,6 +397,12 @@ def run_n(
                     m.counters.get("cluster.msgs_handled", 0) / wall, 1
                 ),
                 "frames_sent": frames,
+                "msgs_sent": msgs_sent,
+                # the coalescing ratio: protocol messages per wire
+                # frame (1.0 = the per-message arm, > 1 = batching)
+                "msgs_per_frame": (
+                    round(msgs_sent / frames, 2) if frames else None
+                ),
                 "wire_mb": round(wire_bytes / 1e6, 2),
                 "batches_sha": digest.hexdigest()[:16],
                 "protocol_faults": m.counters.get("cluster.protocol_faults", 0),
@@ -397,6 +433,7 @@ def main() -> None:
     proc = (
         os.environ.get("BENCH_PROC") == "1" or impl.endswith("_proc")
     )
+    coalesce_ab = os.environ.get("BENCH_TCP_COALESCE_AB") == "1" and not proc
     preload_engine_serde()
     for n in ns:
         if proc:
@@ -405,9 +442,27 @@ def main() -> None:
             # default, native, native_proc) → native_proc.
             worker_impl = "python" if impl.startswith("python") else "native"
             rec = run_n_proc(n, epochs, deadline, seed, impl=worker_impl)
+            print(json.dumps(rec), flush=True)
+        elif coalesce_ab:
+            # Dual-arm mode (round 20): both coalescing arms back to
+            # back on one build, one line each.  Presubmit drive makes
+            # batches_sha cross-arm comparable — a digest mismatch
+            # means the coalescing layer changed protocol semantics,
+            # so it is a hard failure, not a footnote.
+            arms = []
+            for arm in (False, True):
+                rec = run_n(n, epochs, deadline, impl, drive, seed,
+                            coalesce=arm)
+                arms.append(rec)
+                print(json.dumps(rec), flush=True)
+            if drive == "presubmit" and all(a["complete"] for a in arms):
+                assert arms[0]["batches_sha"] == arms[1]["batches_sha"], (
+                    "coalescing arms committed different batches: "
+                    f"{arms[0]['batches_sha']} vs {arms[1]['batches_sha']}"
+                )
         else:
             rec = run_n(n, epochs, deadline, impl, drive, seed)
-        print(json.dumps(rec), flush=True)
+            print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

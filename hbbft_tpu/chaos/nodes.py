@@ -8,7 +8,10 @@ transport's send surface:
 * the Python :class:`~hbbft_tpu.transport.cluster.ClusterNode` emits
   via per-message ``transport.send(dest, payload)``;
 * the native :class:`~hbbft_tpu.transport.native_node.
-  NativeClusterNode` emits via batched ``transport.send_many(items)``.
+  NativeClusterNode` emits via batched ``transport.send_many(items)``
+  and, on the round-20 coalescing fast path, pre-packed MSGB bodies
+  via ``transport.send_wire`` / ``transport.send_msgb`` (unpacked here
+  so strategies keep seeing logical messages).
 
 :func:`install_byzantine` wraps both entry points on the node's OWN
 transport instance (nobody else sends through it), mapping every
@@ -91,7 +94,31 @@ def _wrap_transport(node: Any, strategy: ByzantineStrategy) -> None:
         if out:
             orig_send_many(out)
 
+    def send_msgb(dest: Any, body: bytes, count: int) -> None:
+        # The round-20 native fast path emits pre-packed MSGB bodies;
+        # strategies operate on logical messages, so unpack here and
+        # route through the wrapped send_many (which re-coalesces the
+        # survivors).  decode_msgb is cheap next to the strategy work.
+        from hbbft_tpu.transport.framing import decode_msgb
+
+        send_many([(dest, p) for p in decode_msgb(body)])
+
+    def send_wire(records) -> None:
+        # Whole-sweep fast path (round 20): same unpacking stance as
+        # send_msgb — flatten every record to logical messages and let
+        # the wrapped send_many re-coalesce the survivors.
+        from hbbft_tpu.transport.framing import decode_msgb
+
+        flat = []
+        for dest, count, data in records:
+            if count <= 1:
+                flat.append((dest, data))
+            else:
+                flat.extend((dest, p) for p in decode_msgb(data))
+        send_many(flat)
+
     t.send, t.send_many = send, send_many
+    t.send_msgb, t.send_wire = send_msgb, send_wire
 
 
 def _install_native_tamper(node: Any, strategy: ByzantineStrategy) -> None:

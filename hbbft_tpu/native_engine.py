@@ -323,6 +323,20 @@ def _load(words: int) -> Optional[ctypes.CDLL]:
     lib.hbe_node_egress_bytes.argtypes = [ctypes.c_void_p]
     lib.hbe_node_egress_drain.restype = ctypes.c_int64
     lib.hbe_node_egress_drain.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    # MSGB wire fast path (round 20 coalescing).  Guarded: pre-20 engine
+    # snapshots loaded via HBBFT_TPU_ENGINE_LIB lack these symbols —
+    # callers check NativeNodeEngine.supports_wire_batch and fall back
+    # to the per-frame entry points above.
+    if hasattr(lib, "hbe_node_ingest_wire"):
+        lib.hbe_node_ingest_wire.restype = ctypes.c_int64
+        lib.hbe_node_ingest_wire.argtypes = [
+            ctypes.c_void_p, i32p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32, cp,
+        ]
+        lib.hbe_node_egress_drain_msgb.restype = ctypes.c_int64
+        lib.hbe_node_egress_drain_msgb.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ]
     lib.hbe_node_stat.restype = ctypes.c_uint64
     lib.hbe_node_stat.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     # flight recorder (round 12): bounded milestone event ring
@@ -1696,6 +1710,39 @@ class NativeNodeEngine(_EngineNetBase):
         self._raise_cb_error()
         return handled
 
+    @property
+    def supports_wire_batch(self) -> bool:
+        """True when the loaded engine exports the round-20 MSGB wire
+        fast path (pre-20 HBBFT_TPU_ENGINE_LIB snapshots do not)."""
+        return hasattr(self.lib, "hbe_node_ingest_wire")
+
+    def ingest_wire(self, senders: List[int], records: List[Tuple[int, bytes]]) -> int:
+        """Decode + enqueue one transport read burst in WIRE form: record
+        i is ``(nmsg, data)`` — ``nmsg == 0`` a plain MSG payload,
+        ``nmsg >= 1`` a validated raw MSGB body carrying that many
+        messages, walked entirely in C (no Python slicing).  Returns the
+        consumable-MESSAGE count.  Follow with :meth:`run`."""
+        k = len(records)
+        if k == 0:
+            return 0
+        offs = (ctypes.c_uint64 * (k + 1))()
+        nmsgs = (ctypes.c_uint32 * k)()
+        pos = 0
+        for i, (nm, data) in enumerate(records):
+            offs[i] = pos
+            nmsgs[i] = nm
+            pos += len(data)
+        offs[k] = pos
+        handled = int(
+            self.lib.hbe_node_ingest_wire(
+                self.handle,
+                (ctypes.c_int32 * k)(*senders),
+                nmsgs, offs, k, b"".join(d for _, d in records),
+            )
+        )
+        self._raise_cb_error()
+        return handled
+
     def run(self, max_deliveries: int = 1 << 62) -> int:
         """Drain the local delivery queue (returns when it is empty;
         in ext mode the queue-dry flush hands pending verifications to
@@ -1728,6 +1775,41 @@ class NativeNodeEngine(_EngineNetBase):
             send(dest, bytes(data[pos + 8:pos + 8 + ln]))
             pos += 8 + ln
         return nrec
+
+    def drain_egress_msgb(
+        self, emit: Callable[[int, int, bytes], None], max_body: int,
+    ) -> int:
+        """Drain every pending egress payload as per-destination MSGB
+        bodies built in C (round 20 coalescing): one
+        ``emit(dest, nmsg, body)`` per group, where ``body`` is the
+        framing MSGB grammar and groups split at ``max_body`` payload
+        bytes.  Returns the group count.  Callers strip ``nmsg == 1``
+        groups to plain MSG frames (``body[8:]``) so singletons stay
+        byte-identical to the uncoalesced arm."""
+        lib = self.lib
+        size = int(lib.hbe_node_egress_bytes(self.handle))
+        if not size:
+            return 0
+        # Worst case per entry is 20B overhead + payload vs the 8B the
+        # sizing entry reports, so 3x + slack provably covers it.
+        cap = 3 * size + 64
+        buf = (ctypes.c_uint8 * cap)()
+        nbytes = int(
+            lib.hbe_node_egress_drain_msgb(self.handle, max_body, buf, cap)
+        )
+        if nbytes <= 0:
+            return 0
+        data = memoryview(buf)  # zero-copy view; body slices copy once
+        pos = 0
+        groups = 0
+        while pos < nbytes:
+            dest = int.from_bytes(data[pos:pos + 4], "little")
+            nmsg = int.from_bytes(data[pos + 4:pos + 8], "little")
+            ln = int.from_bytes(data[pos + 8:pos + 12], "little")
+            emit(dest, nmsg, bytes(data[pos + 12:pos + 12 + ln]))
+            pos += 12 + ln
+            groups += 1
+        return groups
 
     def stats(self) -> Dict[str, int]:
         return {

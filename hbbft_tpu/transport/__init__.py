@@ -24,13 +24,19 @@ from hbbft_tpu.transport.faults import (
 from hbbft_tpu.transport.framing import (
     KIND_HELLO,
     KIND_MSG,
+    KIND_MSGB,
     MAX_FRAME_LEN,
     PROTO_VERSION,
     RECV_CHUNK,
     FrameDecoder,
     FrameError,
     decode_hello,
+    decode_msgb,
     encode_frame,
     encode_hello,
+    encode_msgb,
+    frame_message_count,
+    msgb_body,
+    validate_msgb,
 )
 from hbbft_tpu.transport.transport import PeerStats, TcpTransport

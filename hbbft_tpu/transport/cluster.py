@@ -214,15 +214,22 @@ class ClusterNode:
         self._stop = False
         self._ran_before = False
         self._lock = threading.Lock()  # snapshot vs append on outputs
-        transport.on_message = self._on_frame_payload
+        # Burst consumer (round 20): one inbox item per read burst with
+        # all-or-nothing consumption — the frame-atomic unit the MSGB
+        # ACK contract needs (a partially-consumed batch frame would be
+        # re-delivered whole after a reconnect).  The transport unpacks
+        # MSGB bodies before this callback, so mixed clusters interop
+        # regardless of the peer's coalesce arm.
+        transport.on_batch = self._on_frame_burst
 
     # -- transport thread ----------------------------------------------
-    def _on_frame_payload(self, sender: Any, payload: bytes):
+    def _on_frame_burst(self, sender: Any, payloads: List[bytes]) -> int:
         try:
-            self.inbox.put_nowait(("msg", sender, payload))
+            self.inbox.put_nowait(("msgs", sender, payloads))
         except queue.Full:
             self.metrics.count("cluster.inbox_overflow")
-            return False  # transport: do not ack; drop the connection
+            return 0  # nothing consumed: transport drops the conn un-acked
+        return len(payloads)
 
     # -- any thread ----------------------------------------------------
     def submit(self, input: Any) -> None:
@@ -294,32 +301,54 @@ class ClusterNode:
             # (era 0, epoch 0) before its protocol thread first runs.
             self._ran_before = True
             _trace.emit("epoch.open", era=0, epoch=0)
+        egress: List[Tuple[Any, bytes]] = []
         while not self._stop:
             try:
                 kind, a, b = self.inbox.get(timeout=0.2)
             except queue.Empty:
                 continue
-            try:
-                if kind == "msg":
-                    msg = serde.try_loads(b, suite=self.suite)
-                    # any well-formed-but-wrong-type payload is still
-                    # peer-authored garbage, not a local handler bug
-                    if msg is None or not isinstance(msg, SqMessage):
-                        self.metrics.count("cluster.bad_payload")
-                        continue
-                    self.metrics.count("cluster.msgs_handled")
-                    step = self.protocol.handle_message(a, msg, self.rng)
-                else:  # input
+            egress.clear()
+            if kind == "msgs":
+                # Exception scope is per MESSAGE, not the burst: the
+                # frames behind a failing one were already consumed +
+                # ACKed by the transport, so skipping them would lose
+                # acknowledged traffic with no retransmit.  A handler
+                # bug must not take the thread down either way — count
+                # it loudly; tests assert this stays zero.
+                for payload in b:
+                    try:
+                        msg = serde.try_loads(payload, suite=self.suite)
+                        # any well-formed-but-wrong-type payload is
+                        # still peer-authored garbage, not a local
+                        # handler bug
+                        if msg is None or not isinstance(msg, SqMessage):
+                            self.metrics.count("cluster.bad_payload")
+                            continue
+                        self.metrics.count("cluster.msgs_handled")
+                        step = self.protocol.handle_message(a, msg, self.rng)
+                        self._process_step(step, egress)
+                    except Exception:
+                        self.metrics.count("cluster.handler_errors")
+            else:  # input
+                try:
                     step = self.protocol.handle_input(a, self.rng)
-                self._process_step(step)
+                    self._process_step(step, egress)
+                except Exception:
+                    self.metrics.count("cluster.handler_errors")
+            try:
                 while self.pool:
-                    self._process_step(self.pool.flush(self.backend))
+                    self._process_step(self.pool.flush(self.backend), egress)
+                if egress:
+                    # One control-plane hand-off per inbox item: the
+                    # transport packs each peer's payloads into MSGB
+                    # frames (or per-message MSG frames, coalesce off).
+                    self.transport.send_many(list(egress))
             except Exception:
-                # A handler bug must not take the thread down mid-run —
-                # count it loudly; tests assert this stays zero.
                 self.metrics.count("cluster.handler_errors")
 
-    def _process_step(self, step: Step) -> None:
+    def _process_step(
+        self, step: Step, egress: Optional[List[Tuple[Any, bytes]]] = None
+    ) -> None:
         if step.output:
             batches = [o for o in step.output if isinstance(o, DhbBatch)]
             with self._lock:
@@ -332,7 +361,10 @@ class ClusterNode:
         for tm in step.messages:
             data = serde.dumps(tm.message)
             for dest in tm.target.recipients(self.all_ids, self.id):
-                self.transport.send(dest, data)
+                if egress is not None:
+                    egress.append((dest, data))
+                else:
+                    self.transport.send(dest, data)
 
 
 def _default_protocol_factory(

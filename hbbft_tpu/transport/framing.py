@@ -36,12 +36,22 @@ Frame kinds:
   an :class:`~hbbft_tpu.protocols.sender_queue.SqMessage` tree, decoded
   with the cluster's suite pin.
 * ``KIND_ACK`` — cumulative delivery acknowledgement, payload a fixed
-  8-byte big-endian count of MSG frames the acceptor has consumed on
-  this link *ever* (across reconnects).  Flows acceptor -> dialer on
+  8-byte big-endian count of MSG/MSGB frames the acceptor has consumed
+  on this link *ever* (across reconnects).  Flows acceptor -> dialer on
   the otherwise-unused reverse direction of a connection; the dialer
   retains unacked frames and retransmits them after a reconnect, which
   is what makes a mid-epoch disconnect lossless for a surviving process
   (transport.py "resume layer").
+* ``KIND_MSGB`` — one frame carrying a BATCH of protocol messages for
+  the same destination (round 20 coalescing: the per-message frame
+  header, CRC, ACK-accounting, and Python dispatch costs were the
+  measured message-plane bound once decode moved native).  The body
+  grammar is :func:`msgb_body`; the ACK unit stays the FRAME, consumed
+  batch-atomically — a receiver never acknowledges an MSGB it only
+  partially consumed, so the resume layer's cumulative count is
+  unchanged.  Every decoder accepts MSGB regardless of the
+  ``HBBFT_TPU_COALESCE`` knob (accept-both interop: the knob gates
+  EMISSION only, so mixed clusters never desync).
 
 Decode errors raise :class:`FrameError`; the transport's uniform
 response is: count the fault in metrics, drop the connection (the
@@ -73,8 +83,9 @@ PROTO_VERSION = 1
 KIND_HELLO = 0x01
 KIND_MSG = 0x02
 KIND_ACK = 0x03
+KIND_MSGB = 0x04
 
-_KINDS = (KIND_HELLO, KIND_MSG, KIND_ACK)
+_KINDS = (KIND_HELLO, KIND_MSG, KIND_ACK, KIND_MSGB)
 
 #: Crypto-plane RPC kinds (hbbft_tpu.cryptoplane.proc_service).  They
 #: share the frame grammar (same length/CRC slicing, same caps) but are
@@ -98,6 +109,100 @@ def decode_ack(payload: bytes) -> int:
     if len(payload) != 8:
         raise FrameError("ACK payload must be 8 bytes")
     return int.from_bytes(payload, "big")
+
+
+# MSGB body grammar (# mirror: msgb-grammar — native/engine.cpp emits
+# and consumes the identical layout in hbe_node_egress_drain_msgb /
+# hbe_node_ingest_wire):
+#
+#     body := count:u32  ( len:u32  bytes[len] ) * count
+#
+# Both u32 fields are big-endian like the frame header.  The element
+# lengths must sum EXACTLY to the body: trailing bytes, a short
+# element, or count == 0 are FrameErrors — a Byzantine batch never
+# partially parses, so the frame-unit ACK can treat MSGB consumption as
+# all-or-nothing.  A bogus count dies on arithmetic alone (each element
+# needs at least its 4-byte length header) before any walking.
+
+_MSGB_COUNT_BYTES = 4
+_MSGB_LEN_BYTES = 4
+
+
+def msgb_body(payloads: List[bytes]) -> bytes:
+    """The MSGB body carrying ``payloads`` in order (trusted input: our
+    own egress path; peers go through :func:`validate_msgb`)."""
+    parts = [len(payloads).to_bytes(_MSGB_COUNT_BYTES, "big")]
+    for p in payloads:
+        parts.append(len(p).to_bytes(_MSGB_LEN_BYTES, "big"))
+        parts.append(p)
+    return b"".join(parts)
+
+
+def encode_msgb(
+    payloads: List[bytes], max_frame_len: int = MAX_FRAME_LEN
+) -> bytes:
+    """One MSGB frame carrying ``payloads`` in order."""
+    return encode_frame(KIND_MSGB, msgb_body(payloads), max_frame_len)
+
+
+def validate_msgb(body: bytes) -> int:
+    """Bounds-check an MSGB body without slicing any element; returns
+    the message count.  Raises :class:`FrameError` on any grammar
+    violation (peer-authored input: never a crash)."""
+    n = len(body)
+    if n < _MSGB_COUNT_BYTES:
+        raise FrameError("MSGB body shorter than its count field")
+    count = int.from_bytes(body[:_MSGB_COUNT_BYTES], "big")
+    if count < 1:
+        raise FrameError("MSGB with zero messages")
+    if _MSGB_COUNT_BYTES + _MSGB_LEN_BYTES * count > n:
+        raise FrameError(f"MSGB count {count} exceeds body size {n}")
+    # Single-accumulator walk (this runs once per MSGB element on the
+    # ingress hot path): the final exactness check alone rejects every
+    # violation.  An overlong element or truncated header pushes ``off``
+    # strictly past ``n`` — a short/empty length slice yields ln parsed
+    # from k < 4 bytes, and off + 4 + ln > n whenever off + 4 > n — and
+    # once past, off only grows, so it can never land back on n; a
+    # trailing-bytes violation leaves off < n.  Loop length is bounded
+    # by the count pre-check above (count <= n/4).
+    off = _MSGB_COUNT_BYTES
+    for _ in range(count):
+        off += _MSGB_LEN_BYTES + int.from_bytes(
+            body[off : off + _MSGB_LEN_BYTES], "big"
+        )
+    if off != n:
+        raise FrameError("malformed MSGB element layout")
+    return count
+
+
+def decode_msgb(body: bytes) -> List[bytes]:
+    """The payload list of an MSGB body (validates first; raises
+    :class:`FrameError` on violation)."""
+    count = validate_msgb(body)
+    out: List[bytes] = []
+    off = _MSGB_COUNT_BYTES
+    for _ in range(count):
+        ln = int.from_bytes(body[off : off + _MSGB_LEN_BYTES], "big")
+        off += _MSGB_LEN_BYTES
+        out.append(body[off : off + ln])
+        off += ln
+    return out
+
+
+def frame_message_count(frame: bytes) -> int:
+    """Protocol messages a fully-encoded wire frame carries: 1 for MSG,
+    the count field for MSGB, 0 for anything else.  Trusted input (the
+    egress path's own encoder output) — no validation."""
+    if len(frame) <= _HDR_BYTES:
+        return 0
+    kind = frame[_HDR_BYTES]
+    if kind == KIND_MSG:
+        return 1
+    if kind == KIND_MSGB:
+        start = _HDR_BYTES + 1
+        return int.from_bytes(frame[start : start + _MSGB_COUNT_BYTES], "big")
+    return 0
+
 
 _LEN_BYTES = 4
 _CRC_BYTES = 4
@@ -143,7 +248,7 @@ class FrameDecoder:
     no recoverable sync point) — callers drop the connection.
     """
 
-    __slots__ = ("max_frame_len", "kinds", "_buf", "_poisoned")
+    __slots__ = ("max_frame_len", "kinds", "_buf", "_pos", "_poisoned")
 
     def __init__(
         self,
@@ -153,32 +258,51 @@ class FrameDecoder:
         self.max_frame_len = max_frame_len
         self.kinds = kinds
         self._buf = bytearray()
+        # Consumed-prefix cursor: deleting each frame's bytes off the
+        # buffer head (`del buf[:n]`) was quadratic over a large read
+        # burst — every frame moved the whole remainder.  The cursor
+        # just advances; the consumed prefix is dropped in ONE compaction
+        # when parsing stops (no complete frame left), so a burst costs
+        # one move total regardless of how many frames it held.
+        self._pos = 0
         self._poisoned = False
 
     def feed(self, data: bytes) -> None:
         if self._poisoned:
             raise FrameError("decoder poisoned by an earlier frame error")
+        if self._pos and self._pos == len(self._buf):
+            # fully drained: reset instead of growing behind the cursor
+            self._buf.clear()
+            self._pos = 0
         self._buf += data
 
     def buffered(self) -> int:
-        return len(self._buf)
+        return len(self._buf) - self._pos
+
+    def _compact(self) -> None:
+        if self._pos:
+            del self._buf[: self._pos]
+            self._pos = 0
 
     def next_frame(self) -> Optional[Tuple[int, bytes]]:
         if self._poisoned:
             raise FrameError("decoder poisoned by an earlier frame error")
-        buf = self._buf
-        if len(buf) < _LEN_BYTES:
+        buf, pos = self._buf, self._pos
+        avail = len(buf) - pos
+        if avail < _LEN_BYTES:
+            self._compact()
             return None
-        length = int.from_bytes(buf[:_LEN_BYTES], "big")
+        length = int.from_bytes(buf[pos : pos + _LEN_BYTES], "big")
         if length < 1 or length > self.max_frame_len:
             self._poisoned = True
             raise FrameError(
                 f"declared frame length {length} outside [1, {self.max_frame_len}]"
             )
-        if len(buf) < _HDR_BYTES + length:
+        if avail < _HDR_BYTES + length:
+            self._compact()
             return None
-        crc = int.from_bytes(buf[_LEN_BYTES:_HDR_BYTES], "big")
-        body = bytes(buf[_HDR_BYTES : _HDR_BYTES + length])
+        crc = int.from_bytes(buf[pos + _LEN_BYTES : pos + _HDR_BYTES], "big")
+        body = bytes(buf[pos + _HDR_BYTES : pos + _HDR_BYTES + length])
         if zlib.crc32(body) != crc:
             self._poisoned = True
             raise FrameError("frame CRC mismatch (channel corruption)")
@@ -186,7 +310,7 @@ class FrameDecoder:
         if kind not in self.kinds:
             self._poisoned = True
             raise FrameError(f"unknown frame kind 0x{kind:02x}")
-        del buf[: _HDR_BYTES + length]
+        self._pos = pos + _HDR_BYTES + length
         return kind, body[1:]
 
     def frames(self) -> List[Tuple[int, bytes]]:

@@ -6,15 +6,18 @@ stepped by a Python thread, while the same engine moves 1.7M msgs/s
 in-process (BASELINE.md round 8).  This runtime closes that gap with
 the engine's message-boundary API (round 9):
 
-* the transport's burst consumer (``TcpTransport.on_batch``) queues one
-  inbox item per read burst — a list of MSG payloads, not one Python
-  callback per frame;
+* the transport's burst consumer (``TcpTransport.on_wire_batch``)
+  queues one inbox item per read burst — a list of ``(nmsg, data)``
+  wire records (plain MSG payloads and raw MSGB bodies), not one
+  Python callback per frame;
 * the protocol thread packs each burst into ONE ctypes call
-  (``hbe_node_ingest_frames``: decode + epoch-announce handling +
-  enqueue, all in C), drains the engine's delivery queue with one
-  ``hbe_run``, and hands the accumulated egress frames (serde-encoded
-  and epoch-gated in C — the native SenderQueue mirror) back to
-  ``transport.send``;
+  (``hbe_node_ingest_wire``: MSGB body walk + decode + epoch-announce
+  handling + enqueue, all in C — no Python slicing of batch bodies),
+  drains the engine's delivery queue with one ``hbe_run``, and hands
+  the accumulated egress back as per-destination MSGB bodies built in
+  C (``hbe_node_egress_drain_msgb`` — the round-20 coalescing fast
+  path; ``HBBFT_TPU_COALESCE=0`` or a pre-20 engine snapshot falls
+  back to the round-9 per-frame drain);
 * the per-BATCH layers stay the reused Python stack
   (``QueueingHoneyBadger`` over :class:`~hbbft_tpu.native_engine.
   NativeDhb`), fired through the engine's batch callbacks exactly as in
@@ -130,7 +133,15 @@ class NativeClusterNode:
         # (the engine is not thread-safe, so trace_dropped() must not
         # call into it from a scraper thread); GIL-atomic int read.
         self._engine_trace_dropped = 0
-        transport.on_batch = self._on_frame_burst
+        # Ingress: the wire-record consumer (raw MSGB bodies cross as
+        # one record, walked in C) when the engine exports the round-20
+        # fast path; otherwise the round-9 payload-burst consumer (the
+        # transport unpacks MSGB bodies for it — accept-both interop
+        # either way, regardless of the coalesce knob).
+        if self.engine.supports_wire_batch:
+            transport.on_wire_batch = self._on_wire_burst
+        else:
+            transport.on_batch = self._on_frame_burst
 
     # -- transport thread ----------------------------------------------
     def _on_frame_burst(self, sender: Any, payloads: List[bytes]) -> int:
@@ -140,6 +151,16 @@ class NativeClusterNode:
             self.metrics.count("cluster.inbox_overflow")
             return 0  # nothing consumed: connection drops un-acked
         return len(payloads)
+
+    def _on_wire_burst(
+        self, sender: Any, records: List[Tuple[int, bytes]]
+    ) -> int:
+        try:
+            self.inbox.put_nowait(("wire", sender, records))
+        except queue.Full:
+            self.metrics.count("cluster.inbox_overflow")
+            return 0  # nothing consumed: connection drops un-acked
+        return len(records)  # frames, all-or-nothing (batch-atomic ACK)
 
     # -- any thread ----------------------------------------------------
     def submit(self, input: Any) -> None:
@@ -206,6 +227,11 @@ class NativeClusterNode:
         egress: List[tuple] = []
         def collect(dest: int, payload: bytes) -> None:
             egress.append((dest, payload))
+        # Egress arm, resolved once: C-built MSGB bodies when the knob
+        # is on AND the engine exports the fast path, else the round-9
+        # per-frame drain (send_many still respects the knob for the
+        # Python-side packing of those frames).
+        coalesce_out = self.transport.coalesce and eng.supports_wire_batch
         while not self._stop:
             try:
                 item = self.inbox.get(timeout=0.2)
@@ -245,6 +271,25 @@ class NativeClusterNode:
                         eng.run()
                     except Exception:
                         self.metrics.count("cluster.handler_errors")
+                elif burst[i][0] == "wire":
+                    wsenders: List[int] = []
+                    records: List[Tuple[int, bytes]] = []
+                    nmsgs = 0
+                    while i < len(burst) and burst[i][0] == "wire":
+                        _, s, recs = burst[i]
+                        wsenders.extend([s] * len(recs))
+                        records.extend(recs)
+                        nmsgs += sum(nm if nm else 1 for nm, _ in recs)
+                        i += 1
+                    try:
+                        handled = eng.ingest_wire(wsenders, records)
+                        self.metrics.count("cluster.msgs_handled", handled)
+                        bad = nmsgs - handled
+                        if bad:
+                            self.metrics.count("cluster.bad_payload", bad)
+                        eng.run()
+                    except Exception:
+                        self.metrics.count("cluster.handler_errors")
                 else:  # input
                     item_input = burst[i][1]
                     i += 1
@@ -253,15 +298,42 @@ class NativeClusterNode:
                     except Exception:
                         self.metrics.count("cluster.handler_errors")
             try:
-                egress.clear()
-                eng.drain_egress(collect)
-                if egress:
-                    # one control-plane hand-off for the whole sweep's
-                    # emissions (send_many: one wakeup, one drain op)
-                    self.transport.send_many(egress)
+                if coalesce_out:
+                    self._drain_egress_coalesced(egress)
+                else:
+                    egress.clear()
+                    eng.drain_egress(collect)
+                    if egress:
+                        # one control-plane hand-off for the whole
+                        # sweep's emissions (send_many: one wakeup,
+                        # one drain op)
+                        self.transport.send_many(egress)
             except Exception:
                 self.metrics.count("cluster.handler_errors")
             self._guarded_sync()
+
+    def _drain_egress_coalesced(self, scratch: List[tuple]) -> None:
+        """Egress sweep on the MSGB fast path: the engine hands back
+        per-destination MSGB bodies built in C; multi-message groups
+        stay pre-packed bodies (one frame each, zero Python re-packing)
+        and singleton groups are stripped to plain MSG payloads —
+        byte-identical to the uncoalesced arm.  The WHOLE sweep leaves
+        as one :meth:`TcpTransport.send_wire` call (one wakeup byte,
+        one loop-thread drain op for all destinations — not one post
+        per group), and since send_wire preserves emission order,
+        per-destination FIFO holds with no buffering dance."""
+        scratch.clear()  # (dest, count, data) wire records, in order
+
+        def emit(dest: int, nmsg: int, body: bytes) -> None:
+            if nmsg <= 1:
+                scratch.append((dest, 1, body[8:]))
+            else:
+                scratch.append((dest, nmsg, body))
+
+        self.engine.drain_egress_msgb(emit, self.transport.max_frame_len - 1)
+        if scratch:
+            self.transport.send_wire(list(scratch))
+            scratch.clear()
 
     def _guarded_sync(self) -> None:
         """Protocol-thread sync with the standard never-die guard: the

@@ -75,15 +75,20 @@ from hbbft_tpu.transport.framing import (
     KIND_ACK,
     KIND_HELLO,
     KIND_MSG,
+    KIND_MSGB,
     MAX_FRAME_LEN,
     RECV_CHUNK,
     FrameDecoder,
     FrameError,
     decode_ack,
     decode_hello,
+    decode_msgb,
     encode_ack,
     encode_frame,
     encode_hello,
+    frame_message_count,
+    msgb_body,
+    validate_msgb,
 )
 from hbbft_tpu.utils.metrics import Metrics
 
@@ -96,6 +101,13 @@ class PeerStats:
     frames_out: int = 0
     bytes_in: int = 0
     frames_in: int = 0
+    # Coalescing efficiency (round 20): protocol messages carried by
+    # the frames above — an MSG frame counts 1, an MSGB frame counts
+    # its batch size.  msgs/frames is the msgs-per-frame ratio the
+    # config6/config7 JSON lines surface, so A/B arms self-describe
+    # how much the wire actually coalesced.
+    msgs_out: int = 0
+    msgs_in: int = 0
     queue_frames: int = 0
     queue_bytes: int = 0
     queue_overflow: int = 0
@@ -189,6 +201,14 @@ def _sendmsg_default() -> bool:
     return SENDMSG_AVAILABLE and os.environ.get("HBBFT_TPU_SENDMSG", "1") != "0"
 
 
+def _coalesce_default() -> bool:
+    """Message coalescing (round 20): ``HBBFT_TPU_COALESCE=0`` restores
+    per-message MSG frames on the same build — the A/B arm.  The knob
+    gates EMISSION only; every decoder keeps accepting MSGB, so mixed
+    clusters interoperate in either setting."""
+    return os.environ.get("HBBFT_TPU_COALESCE", "1") != "0"
+
+
 class _Outbound:
     """Dialer-side state toward one peer.
 
@@ -279,6 +299,9 @@ class TcpTransport:
         peers: Optional[Dict[Any, Tuple[str, int]]] = None,
         on_message: Optional[Callable[[Any, bytes], None]] = None,
         on_batch: Optional[Callable[[Any, List[bytes]], int]] = None,
+        on_wire_batch: Optional[
+            Callable[[Any, List[Tuple[int, bytes]]], int]
+        ] = None,
         host: str = "127.0.0.1",
         port: int = 0,
         max_frame_len: int = MAX_FRAME_LEN,
@@ -295,6 +318,7 @@ class TcpTransport:
         ban_base_s: float = 0.25,
         ban_cap_s: float = 2.0,
         vectored: Optional[bool] = None,
+        coalesce: Optional[bool] = None,
     ) -> None:
         self.node_id = node_id
         self.cluster_id = cluster_id
@@ -309,6 +333,14 @@ class TcpTransport:
         # layer retransmits.  This is what lets a native-engine node
         # move a whole RECV_CHUNK of frames per Python call.
         self.on_batch = on_batch
+        # Wire-burst consumer (round 20): like ``on_batch`` but frames
+        # arrive in WIRE form — ``on_wire_batch(peer, records) ->
+        # frames consumed`` with each record ``(nmsg, data)``: nmsg ==
+        # 0 is a plain MSG payload, nmsg >= 1 a validated raw MSGB body
+        # (grammar-checked here, NOT sliced — the native engine walks
+        # the body in C, which is the whole point).  Precedence:
+        # on_wire_batch > on_batch > on_message.
+        self.on_wire_batch = on_wire_batch
         self.max_frame_len = max_frame_len
         self.max_queue_frames = max_queue_frames
         self.max_queue_bytes = max_queue_bytes
@@ -339,6 +371,12 @@ class TcpTransport:
         if vectored is None:
             vectored = _sendmsg_default()
         self.vectored = bool(vectored) and SENDMSG_AVAILABLE
+        # Message coalescing (round 20): None = auto (HBBFT_TPU_COALESCE
+        # != 0).  Gates egress packing only — ingress accepts MSGB
+        # unconditionally (accept-both interop).
+        if coalesce is None:
+            coalesce = _coalesce_default()
+        self.coalesce = bool(coalesce)
         self._bans: Dict[Any, _BanState] = {}
         # Flight recorder (round 12): an optional TraceBuffer the owner
         # (LocalCluster) installs; connect/disconnect/ban milestones land
@@ -447,29 +485,124 @@ class TcpTransport:
     def send_many(self, items: List[Tuple[Any, bytes]]) -> None:
         """Frame + queue a batch of ``(dest, payload)`` messages with ONE
         control-plane hand-off (one wakeup byte and one loop-thread drain
-        op instead of one per message).  Semantically identical to
-        calling :meth:`send` per item — the fault injector still plans
-        each frame individually — but this is what keeps the native
-        node's egress drain off the per-message syscall treadmill."""
-        by_dest: Dict[Any, List[Tuple[Any, float, bytes, Optional[bytes]]]] = {}
+        op instead of one per message).  With coalescing on (round 20,
+        the default) each destination's run leaves as the fewest frames
+        the caps allow — MSGB batches bounded by ``max_frame_len``,
+        singletons as plain MSG — making the FRAME the ACK/resume unit
+        for the whole batch; ``coalesce=False`` restores one MSG frame
+        per message (the A/B arm).  Either way the fault injector still
+        plans each *frame* individually, and per-dest FIFO order — the
+        only order the transport guarantees — is preserved (grouping by
+        dest never reorders within a dest)."""
+        by_dest: Dict[Any, List[bytes]] = {}
         for dest, payload in items:
-            frame = encode_frame(KIND_MSG, payload, self.max_frame_len)
+            by_dest.setdefault(dest, []).append(payload)
+        batch: List[Tuple[Any, float, bytes, Optional[bytes]]] = []
+        for dest, payloads in by_dest.items():
+            for frame in self._pack_frames(payloads):
+                if self.injector is not None:
+                    plan = self.injector.on_send(self.node_id, dest, frame)
+                else:
+                    plan = ((0.0, frame),)
+                for delay_s, data in plan:
+                    wire = data if data != frame else None
+                    batch.append((dest, delay_s, frame, wire))
+        if batch:
+            self._post(("enqueue_many", batch))
+
+    def _pack_frames(self, payloads: List[bytes]) -> List[bytes]:
+        """Encode one destination's payload run as wire frames.  With
+        coalescing off: one MSG frame per payload.  On: greedy MSGB
+        groups bounded by ``max_frame_len``; a group that ends up with
+        a single payload stays a plain MSG frame (byte-identical to the
+        uncoalesced arm — no count/length overhead for singletons)."""
+        limit = self.max_frame_len
+        if not self.coalesce or len(payloads) == 1:
+            return [encode_frame(KIND_MSG, p, limit) for p in payloads]
+        frames: List[bytes] = []
+        group: List[bytes] = []
+        group_len = 5  # frame length counts the kind byte + count field
+
+        def close() -> None:
+            if len(group) == 1:
+                frames.append(encode_frame(KIND_MSG, group[0], limit))
+            elif group:
+                frames.append(encode_frame(KIND_MSGB, msgb_body(group), limit))
+
+        for p in payloads:
+            need = 4 + len(p)  # element length header + bytes
+            if group and group_len + need > limit:
+                close()
+                group = []
+                group_len = 5
+            group.append(p)
+            group_len += need
+        close()
+        return frames
+
+    def send_msgb(self, dest: Any, body: bytes, count: int) -> None:
+        """Frame + queue a pre-built MSGB body of ``count`` messages
+        toward ``dest``.  The native engine's egress drain emits bodies
+        already in the wire grammar (framing.py "msgb-grammar"), so the
+        hot path is ONE ``encode_frame`` per (peer, sweep) — no
+        per-message Python at all.  With coalescing off (or a
+        degenerate count) the body is unpacked and routed through
+        :meth:`send_many`, so the A/B knob governs the wire uniformly;
+        the chaos plane wraps this method to keep its per-message
+        egress seam (chaos/nodes.py)."""
+        if count <= 1 or not self.coalesce:
+            self.send_many([(dest, p) for p in decode_msgb(body)])
+            return
+        frame = encode_frame(KIND_MSGB, body, self.max_frame_len)
+        if self.injector is not None:
+            plan = self.injector.on_send(self.node_id, dest, frame)
+        else:
+            plan = ((0.0, frame),)
+        batch = []
+        for delay_s, data in plan:
+            wire = data if data != frame else None
+            batch.append((dest, delay_s, frame, wire))
+        self._post(("enqueue_many", batch))
+
+    def send_wire(
+        self, records: List[Tuple[Any, int, bytes]]
+    ) -> None:
+        """Frame + queue a whole egress sweep of pre-packed wire records
+        with ONE control-plane hand-off.  Each record is ``(dest, count,
+        data)``: a plain MSG payload when ``count <= 1``, else a
+        pre-built MSGB body of ``count`` messages (the native drain's
+        output shape — see :meth:`send_msgb`).  Emission order is
+        preserved end to end (one ``enqueue_many`` op), so per-dest
+        FIFO holds with no caller-side buffering; the drain's per-dest
+        grouping also keeps same-dest records adjacent, which the loop
+        thread's run-batching exploits.  With coalescing off, MSGB
+        records are unpacked and the whole sweep routes through
+        :meth:`send_many` — the A/B knob governs the wire uniformly.
+        The chaos plane wraps this method alongside send/send_many/
+        send_msgb (chaos/nodes.py)."""
+        if not self.coalesce:
+            flat: List[Tuple[Any, bytes]] = []
+            for dest, count, data in records:
+                if count <= 1:
+                    flat.append((dest, data))
+                else:
+                    flat.extend((dest, p) for p in decode_msgb(data))
+            if flat:
+                self.send_many(flat)
+            return
+        limit = self.max_frame_len
+        batch: List[Tuple[Any, float, bytes, Optional[bytes]]] = []
+        for dest, count, data in records:
+            kind = KIND_MSG if count <= 1 else KIND_MSGB
+            frame = encode_frame(kind, data, limit)
             if self.injector is not None:
                 plan = self.injector.on_send(self.node_id, dest, frame)
             else:
                 plan = ((0.0, frame),)
-            for delay_s, data in plan:
-                wire = data if data != frame else None
-                by_dest.setdefault(dest, []).append(
-                    (dest, delay_s, frame, wire)
-                )
-        if by_dest:
-            # grouped by dest (stable within each): broadcast emissions
-            # interleave dests, and the loop thread's run-batched
-            # enqueue only amortizes over same-dest runs.  Per-dest FIFO
-            # order — the only order the transport guarantees — is
-            # preserved.
-            batch = [t for run in by_dest.values() for t in run]
+            for delay_s, d in plan:
+                wire = d if d != frame else None
+                batch.append((dest, delay_s, frame, wire))
+        if batch:
             self._post(("enqueue_many", batch))
 
     def _post(self, item: Tuple[str, Any]) -> None:
@@ -497,6 +630,10 @@ class TcpTransport:
             m.gauge(f"{base}.frames_out", st.frames_out)
             m.gauge(f"{base}.bytes_in", st.bytes_in)
             m.gauge(f"{base}.frames_in", st.frames_in)
+            # coalescing efficiency (round 20): msgs/frames per
+            # direction is the msgs-per-frame ratio of the MSGB plane
+            m.gauge(f"{base}.msgs_out", st.msgs_out)
+            m.gauge(f"{base}.msgs_in", st.msgs_in)
             m.gauge(f"{base}.reconnects", st.reconnects)
             m.gauge(f"{base}.frame_errors", st.frame_errors)
             # peer.* misbehavior gauges (round 11): the <- direction
@@ -814,6 +951,11 @@ class TcpTransport:
                 ob.pending_write.append((len(data), orig))
                 ob.pending_write_bytes += len(orig)
                 st.frames_out += 1
+                # msgs carried (1 per MSG, batch count per MSGB) read
+                # straight off the clean frame bytes: no extra state
+                # threads through queue/inflight/retransmit tuples, and
+                # retransmits recount exactly like frames_out does
+                st.msgs_out += frame_message_count(orig)
             try:
                 n = ob.sock.send(ob.sendbuf)
             except BlockingIOError:
@@ -875,6 +1017,7 @@ class TcpTransport:
                 ob.pending_write.append((len(data), orig))
                 ob.pending_write_bytes += len(orig)
                 st.frames_out += 1
+                st.msgs_out += frame_message_count(orig)
             try:
                 n = ob.sock.sendmsg(bufs)
             except BlockingIOError:
@@ -1004,6 +1147,9 @@ class TcpTransport:
         try:
             conn.decoder.feed(data)
             burst: List[bytes] = []
+            burst_frames: List[int] = []  # msgs per batched frame, in order
+            wire_burst: List[Tuple[int, bytes]] = []
+            batching = self.on_batch is not None or self.on_wire_batch is not None
             # Parse + dispatch one frame at a time (NOT decoder.frames(),
             # which would collect the whole burst before any dispatch):
             # a violation mid-burst must not void the frames before it —
@@ -1017,20 +1163,34 @@ class TcpTransport:
                     break
                 kind, payload = frame
                 if (
-                    self.on_batch is not None
+                    batching
                     and conn.peer_id is not None
-                    and kind == KIND_MSG
+                    and kind in (KIND_MSG, KIND_MSGB)
                 ):
-                    # Batch path: queue the read burst's MSG frames for
-                    # ONE consumer call.  Kind violations in the same
-                    # burst still raise below; frames batched before
-                    # the violation are simply never consumed or acked
-                    # (the resume layer covers them).
-                    burst.append(payload)
+                    # Batch path: queue the read burst's MSG/MSGB frames
+                    # for ONE consumer call.  MSGB bodies are grammar-
+                    # checked HERE (validate_msgb raises FrameError →
+                    # the uniform drop/strike/ban response, identical on
+                    # both node impls) but only the wire path skips the
+                    # slicing.  Kind violations in the same burst still
+                    # raise below; frames batched before the violation
+                    # are simply never consumed or acked (the resume
+                    # layer covers them).
+                    nmsg = 0 if kind == KIND_MSG else validate_msgb(payload)
+                    if self.on_wire_batch is not None:
+                        wire_burst.append((nmsg, payload))
+                    elif kind == KIND_MSG:
+                        burst.append(payload)
+                        burst_frames.append(1)
+                    else:
+                        burst.extend(decode_msgb(payload))
+                        burst_frames.append(nmsg)
                     continue
                 self._handle_frame(conn, kind, payload)
-            if burst:
-                self._dispatch_burst(conn, burst)
+            if wire_burst:
+                self._dispatch_wire_burst(conn, wire_burst)
+            elif burst:
+                self._dispatch_burst(conn, burst, burst_frames)
         except FrameError as exc:
             if isinstance(exc, _BanReject):
                 # The defense firing, not a framing violation: counted
@@ -1144,6 +1304,28 @@ class TcpTransport:
             raise FrameError("ACK frames only flow acceptor->dialer")
         st = self.peer_stats[conn.peer_id]
         st.frames_in += 1
+        if kind == KIND_MSGB:
+            # Per-frame consumer path for a batch frame: unpack (grammar
+            # violations raise FrameError — uniform strike/ban response)
+            # and feed each message through on_message; the ack unit
+            # stays the FRAME, granted only once every message was
+            # offered.  An overload mid-frame leaves the whole frame
+            # unacked (batch-atomic) — the in-repo burst consumers are
+            # all-or-nothing, and protocol dedup covers re-delivery.
+            msgs = decode_msgb(payload)
+            st.msgs_in += len(msgs)
+            if self.on_message is not None:
+                for p in msgs:
+                    try:
+                        res = self.on_message(conn.peer_id, p)
+                    except Exception:
+                        self.metrics.count("transport.on_message_errors")
+                        res = None
+                    if res is False:
+                        raise _ConsumerOverload()
+            self._rx_counts[conn.peer_id] += 1
+            return
+        st.msgs_in += 1
         if self.on_message is not None:
             try:
                 res = self.on_message(conn.peer_id, payload)
@@ -1159,11 +1341,20 @@ class TcpTransport:
         # a disconnect on our side, so it is safe to acknowledge
         self._rx_counts[conn.peer_id] += 1
 
-    def _dispatch_burst(self, conn: _Inbound, burst: List[bytes]) -> None:
-        """Hand one read burst's MSG frames to ``on_batch``; ack exactly
-        the consumed prefix (cumulative-count alignment)."""
+    def _dispatch_burst(
+        self, conn: _Inbound, burst: List[bytes], frame_counts: List[int]
+    ) -> None:
+        """Hand one read burst's MSG/MSGB messages to ``on_batch``; ack
+        exactly the fully-consumed FRAME prefix (the cumulative count
+        stays frame-aligned).  ``frame_counts`` maps the flat message
+        list back to frames; a frame whose messages were only partially
+        consumed is NOT acked — batch-atomic consumption.  (The in-repo
+        consumers are all-or-nothing whole-burst inbox puts, so a
+        partial prefix only ever re-delivers whole frames on resume;
+        protocol-level dedup covers the theoretical partial case.)"""
         st = self.peer_stats[conn.peer_id]
-        st.frames_in += len(burst)
+        st.frames_in += len(frame_counts)
+        st.msgs_in += len(burst)
         try:
             consumed = self.on_batch(conn.peer_id, burst)
         except Exception:
@@ -1173,8 +1364,36 @@ class TcpTransport:
             self.metrics.count("transport.on_message_errors")
             consumed = len(burst)
         consumed = max(0, min(int(consumed), len(burst)))
-        self._rx_counts[conn.peer_id] += consumed
+        frames_done = 0
+        covered = 0
+        for c in frame_counts:
+            if covered + c > consumed:
+                break
+            covered += c
+            frames_done += 1
+        self._rx_counts[conn.peer_id] += frames_done
         if consumed < len(burst):
+            raise _ConsumerOverload()
+
+    def _dispatch_wire_burst(
+        self, conn: _Inbound, records: List[Tuple[int, bytes]]
+    ) -> None:
+        """Hand one read burst's frames to ``on_wire_batch`` in wire
+        form — ``(nmsg, data)`` per frame, nmsg == 0 a plain MSG
+        payload, nmsg >= 1 a validated raw MSGB body.  The return value
+        counts FRAMES consumed (all-or-nothing per frame by contract),
+        which is exactly the ack unit."""
+        st = self.peer_stats[conn.peer_id]
+        st.frames_in += len(records)
+        st.msgs_in += sum(n if n else 1 for n, _ in records)
+        try:
+            consumed = self.on_wire_batch(conn.peer_id, records)
+        except Exception:
+            self.metrics.count("transport.on_message_errors")
+            consumed = len(records)
+        consumed = max(0, min(int(consumed), len(records)))
+        self._rx_counts[conn.peer_id] += consumed
+        if consumed < len(records):
             raise _ConsumerOverload()
 
     def _flush_inbound(self, conn: _Inbound) -> None:

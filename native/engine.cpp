@@ -6369,14 +6369,44 @@ void hbe_set_local(void* h, int32_t local, int32_t window) {
   e->cluster.outbox.assign(e->n, {});
 }
 
+// Consume one protocol-message payload from peer `s` (sender bounds
+// already checked by the caller).  Decoded algo messages queue for the
+// local node; epoch_started announces update the peer window and
+// release held egress; codec-rejects count CL_BAD_PAYLOAD, exactly the
+// Python node's serde.try_loads + isinstance(SqMessage) gate.  Returns
+// true when the payload decoded to a consumable message.
+static bool cluster_consume_payload(Engine& e, ClusterState& c, int32_t s,
+                                    const uint8_t* p, uint64_t len) {
+  WireDecoded wm;
+  if (!wire_decode(p, len, wm)) {
+    c.stats[CL_BAD_PAYLOAD]++;
+    return false;
+  }
+  c.stats[CL_HANDLED]++;
+  if (wm.kind == 1)
+    cluster_on_epoch_started(e, s, wm.era, wm.epoch);
+  else if (wm.kind == 2) {
+    if (e.ext && (wm.msg.type == BA_COIN || wm.msg.type == HB_DECRYPT)) {
+      // External-crypto mode consumes opaque share bytes (share_b);
+      // the wire codec decoded the scalar grammar's 32-byte element
+      // into the U256 slot — rematerialize the exact BE bytes so the
+      // handlers route them to the verify-batch callback instead of
+      // the (keyless, in ext mode) internal scalar checks.
+      uint8_t be[32];
+      u256_to_be32(wm.msg.share, be);
+      wm.msg.share_b = std::make_shared<const Bytes>((const char*)be, 32);
+    }
+    e.queue.push_back(
+        {s, c.local, std::make_shared<const EMsg>(std::move(wm.msg))});
+  } else
+    c.stats[CL_IGNORED]++;
+  return true;
+}
+
 // Ingest one batch of MSG-frame payloads: senders[i] is the (transport-
 // authenticated) peer id of frame i, whose bytes are
-// buf[offsets[i]..offsets[i+1]).  Decoded algo messages queue for the
-// local node (drive with hbe_run); epoch_started announces update the
-// peer window and release held egress; codec-rejects count as
-// bad_payload (CL_BAD_PAYLOAD), exactly the Python node's
-// serde.try_loads + isinstance(SqMessage) gate.  Returns the number of
-// consumable frames, or -1 if not in cluster mode.
+// buf[offsets[i]..offsets[i+1]).  Returns the number of consumable
+// frames, or -1 if not in cluster mode.
 int64_t hbe_node_ingest_frames(void* h, const int32_t* senders,
                                const uint64_t* offsets, int32_t count,
                                const uint8_t* buf) {
@@ -6386,37 +6416,74 @@ int64_t hbe_node_ingest_frames(void* h, const int32_t* senders,
   int64_t handled = 0;
   for (int32_t i = 0; i < count; ++i) {
     int32_t s = senders[i];
-    const uint8_t* p = buf + offsets[i];
-    uint64_t len = offsets[i + 1] - offsets[i];
     if (s < 0 || s >= e.n || s == c.local) {
       c.stats[CL_BAD_PAYLOAD]++;
       continue;
     }
-    WireDecoded wm;
-    if (!wire_decode(p, len, wm)) {
-      c.stats[CL_BAD_PAYLOAD]++;
+    if (cluster_consume_payload(e, c, s, buf + offsets[i],
+                                offsets[i + 1] - offsets[i]))
+      ++handled;
+  }
+  return handled;
+}
+
+// mirror: msgb-grammar
+// Ingest one transport read burst in WIRE form (round 20 coalescing):
+// record i from peer senders[i] covers buf[offsets[i]..offsets[i+1]).
+// nmsgs[i] == 0 means the record is one plain MSG payload; >= 1 means
+// an MSGB body in the framing grammar —
+//     body := count:u32be  ( len:u32be  bytes[len] ) * count
+// carrying that many messages, walked here with no Python slicing (the
+// whole point of the fast path).  The transport grammar-checked every
+// MSGB before handing it over, but each bound is re-checked: a
+// violation counts the record's remaining messages as bad_payload and
+// moves to the next record — defense in depth, never an OOB read.
+// Returns the number of consumable MESSAGES, or -1 if not cluster mode.
+int64_t hbe_node_ingest_wire(void* h, const int32_t* senders,
+                             const uint32_t* nmsgs, const uint64_t* offsets,
+                             int32_t count, const uint8_t* buf) {
+  Engine& e = *(Engine*)h;
+  ClusterState& c = e.cluster;
+  if (c.local < 0) return -1;
+  int64_t handled = 0;
+  for (int32_t i = 0; i < count; ++i) {
+    int32_t s = senders[i];
+    const uint8_t* p = buf + offsets[i];
+    uint64_t len = offsets[i + 1] - offsets[i];
+    uint32_t nm = nmsgs[i];
+    if (s < 0 || s >= e.n || s == c.local) {
+      c.stats[CL_BAD_PAYLOAD] += nm ? nm : 1;
       continue;
     }
-    ++handled;
-    c.stats[CL_HANDLED]++;
-    if (wm.kind == 1)
-      cluster_on_epoch_started(e, s, wm.era, wm.epoch);
-    else if (wm.kind == 2) {
-      if (e.ext &&
-          (wm.msg.type == BA_COIN || wm.msg.type == HB_DECRYPT)) {
-        // External-crypto mode consumes opaque share bytes (share_b);
-        // the wire codec decoded the scalar grammar's 32-byte element
-        // into the U256 slot — rematerialize the exact BE bytes so the
-        // handlers route them to the verify-batch callback instead of
-        // the (keyless, in ext mode) internal scalar checks.
-        uint8_t be[32];
-        u256_to_be32(wm.msg.share, be);
-        wm.msg.share_b = std::make_shared<const Bytes>((const char*)be, 32);
+    if (nm == 0) {
+      if (cluster_consume_payload(e, c, s, p, len)) ++handled;
+      continue;
+    }
+    uint32_t declared = 0;
+    if (len >= 4)
+      declared = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                 ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    uint64_t off = 4;
+    uint32_t done = 0;
+    bool ok = len >= 4 && declared == nm;
+    while (ok && done < nm) {
+      if (off + 4 > len) {
+        ok = false;
+        break;
       }
-      e.queue.push_back(
-          {s, c.local, std::make_shared<const EMsg>(std::move(wm.msg))});
-    } else
-      c.stats[CL_IGNORED]++;
+      uint64_t el = ((uint64_t)p[off] << 24) | ((uint64_t)p[off + 1] << 16) |
+                    ((uint64_t)p[off + 2] << 8) | (uint64_t)p[off + 3];
+      off += 4;
+      if (el > len - off) {
+        ok = false;
+        break;
+      }
+      if (cluster_consume_payload(e, c, s, p + off, el)) ++handled;
+      off += el;
+      ++done;
+    }
+    if (!ok || off != len)  // structural violation or trailing bytes
+      c.stats[CL_BAD_PAYLOAD] += (nm > done) ? (nm - done) : 1;
   }
   return handled;
 }
@@ -6456,6 +6523,81 @@ int64_t hbe_node_egress_drain(void* h, uint8_t* out, uint64_t cap) {
   c.enc_src = nullptr;  // release the broadcast-memo pin with the batch
   c.enc_payload = nullptr;
   return nrec;
+}
+
+// mirror: msgb-grammar
+// Drain ALL pending egress as per-destination MSGB bodies (round 20
+// coalescing): records are
+//     [dest u32 LE][nmsg u32 LE][body_len u32 LE][body]*
+// where body is the framing MSGB grammar —
+//     body := count:u32be  ( len:u32be  bytes[len] ) * count
+// (big-endian like the frame headers; count == nmsg).  Grouping is per
+// DEST across the whole batch: broadcast emission pushes one entry per
+// dest consecutively, so grouping consecutive same-dest runs would
+// coalesce nothing.  Per-dest FIFO — the only order the transport
+// guarantees — is preserved.  Bodies split when the next element would
+// push past `max_body` payload bytes (a single oversized element still
+// gets its own nmsg==1 record; the Python caller strips those to plain
+// MSG frames, exactly the uncoalesced arm's bytes).  Returns bytes
+// written, or -1 if `cap` can't hold the worst case (drains nothing).
+int64_t hbe_node_egress_drain_msgb(void* h, uint64_t max_body, uint8_t* out,
+                                   uint64_t cap) {
+  Engine& e = *(Engine*)h;
+  ClusterState& c = e.cluster;
+  // Worst case: every entry its own record — 12B record header + 4B
+  // count + 4B element header + payload.
+  uint64_t worst = c.egress_bytes + 20ull * c.egress.size();
+  if (worst > cap) return -1;
+  if (max_body < 16) max_body = 16;
+  std::vector<std::vector<uint32_t>> by_dest(e.n);
+  for (uint32_t i = 0; i < (uint32_t)c.egress.size(); ++i) {
+    int32_t d = c.egress[i].first;
+    if (d >= 0 && d < e.n) by_dest[(size_t)d].push_back(i);
+  }
+  auto wr32le = [&](uint64_t at, uint32_t v) {
+    out[at] = (uint8_t)v;
+    out[at + 1] = (uint8_t)(v >> 8);
+    out[at + 2] = (uint8_t)(v >> 16);
+    out[at + 3] = (uint8_t)(v >> 24);
+  };
+  auto wr32be = [&](uint64_t at, uint32_t v) {
+    out[at] = (uint8_t)(v >> 24);
+    out[at + 1] = (uint8_t)(v >> 16);
+    out[at + 2] = (uint8_t)(v >> 8);
+    out[at + 3] = (uint8_t)v;
+  };
+  uint64_t pos = 0;
+  for (int32_t d = 0; d < e.n; ++d) {
+    auto& idxs = by_dest[(size_t)d];
+    uint32_t i = 0;
+    while (i < (uint32_t)idxs.size()) {
+      uint64_t hdr = pos;    // record header, written once nmsg is known
+      uint64_t body0 = hdr + 12;  // body starts with the count field
+      pos = body0 + 4;
+      uint32_t nmsg = 0;
+      uint64_t body_len = 4;
+      while (i < (uint32_t)idxs.size()) {
+        const BytesP& pl = c.egress[idxs[i]].second;
+        uint64_t need = 4ull + pl->size();
+        if (nmsg > 0 && body_len + need > max_body) break;
+        wr32be(pos, (uint32_t)pl->size());
+        std::memcpy(out + pos + 4, pl->data(), pl->size());
+        pos += need;
+        body_len += need;
+        ++nmsg;
+        ++i;
+      }
+      wr32le(hdr, (uint32_t)d);
+      wr32le(hdr + 4, nmsg);
+      wr32le(hdr + 8, (uint32_t)(pos - body0));
+      wr32be(body0, nmsg);
+    }
+  }
+  c.egress.clear();
+  c.egress_bytes = 0;
+  c.enc_src = nullptr;  // release the broadcast-memo pin with the batch
+  c.enc_payload = nullptr;
+  return (int64_t)pos;
 }
 
 // ClStat counters (see the enum): 0 handled, 1 bad_payload, 2 ignored,

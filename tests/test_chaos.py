@@ -389,12 +389,18 @@ def test_tamper_payload_variants_decode_and_differ():
     with LocalCluster(4, seed=3) as c:
         node = c.nodes[1]
         orig = node.transport.send
+        orig_many = node.transport.send_many
 
         def send(dest, payload, _o=orig):
             corpus.append(payload)
             return _o(dest, payload)
 
+        def send_many(items, _o=orig_many):
+            corpus.extend(p for _, p in items)
+            return _o(items)
+
         node.transport.send = send
+        node.transport.send_many = send_many
         c.drive_to([0, 1, 2, 3], 1, timeout_s=EPOCH_TIMEOUT_S)
     rng = _random.Random(17)
     changed = 0

@@ -174,6 +174,42 @@ node.run()
 assert node.stats()["bad_payload"] >= 2, node.stats()
 print("SANITIZED-WIRE-OK")
 
+# Round 20: the MSGB wire fast path on hostile input.  Real per-dest
+# MSGB bodies from hbe_node_egress_drain_msgb come back through
+# hbe_node_ingest_wire interleaved with structurally-corrupt records —
+# claim mismatch, truncation, trailing garbage, an inflated count —
+# the exact C walk where an OOB read hides; then a clamped max_body
+# drain exercises the group-split path.  Verdict parity is pinned in
+# tests/test_transport_native.py; the sanitizer's job here is the
+# memory safety of the reject paths.
+nodeb = NativeNodeEngine(
+    0, build_netinfo(4, 1, 0, _suite, 0), seed=0, batch_size=3,
+    session_id=b"san-msgb",
+)
+nodeb.handle_input(Input.user("msgb-tx"))
+nodeb.run()
+groups = []
+nodeb.drain_egress_msgb(lambda d, nm, b: groups.append((nm, b)), 1 << 20)
+assert any(nm > 1 for nm, _ in groups), "no MSGB groups drained"
+gnm, gbody = next((nm, b) for nm, b in groups if nm > 1)
+records = [
+    (gnm, gbody),                                     # clean batch
+    (gnm + 1, gbody),                                 # claim mismatch
+    (gnm, gbody[: len(gbody) // 2]),                  # truncated
+    (gnm, gbody + bytes([0, 7])),                     # trailing garbage
+    (gnm + 9, (gnm + 9).to_bytes(4, "big") + gbody[4:]),  # inflated count
+    (1, b""),                                         # empty body
+    (0, gbody),                                       # MSGB bytes as MSG
+]
+before20 = nodeb.stats()
+nodeb.ingest_wire([1, 2, 3, 1, 2, 3, 1], records)
+nodeb.run()
+after20 = nodeb.stats()
+assert after20["handled"] - before20["handled"] >= gnm, after20
+assert after20["bad_payload"] - before20["bad_payload"] >= 5, after20
+nodeb.drain_egress_msgb(lambda d, nm, b: None, 1)  # clamped split drain
+print("SANITIZED-MSGB-OK")
+
 # Round 11: one mixed good/equivocating/corrupt ingest batch.  The
 # chaos plane's equivocation/corrupt-share variants are VALID wire
 # traffic (TamperingAdversary rewrites re-encoded over the same serde
@@ -396,6 +432,7 @@ def test_asan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-MSGB-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
@@ -409,6 +446,7 @@ def test_ubsan_native_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-MSGB-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-CHAOS-OK" in res.stdout
     assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
@@ -428,6 +466,7 @@ def test_tsan_multithread_epoch():
     assert "SANITIZED-EPOCH-OK" in res.stdout
     assert "SANITIZED-ERA-OK" in res.stdout
     assert "SANITIZED-RLC-BISECT-OK" in res.stdout
+    assert "SANITIZED-MSGB-OK" in res.stdout
     assert "SANITIZED-SIMD-OK" in res.stdout
     assert "SANITIZED-ARENA-SHA3-OK" in res.stdout
     assert "WARNING: ThreadSanitizer" not in res.stderr

@@ -216,12 +216,18 @@ def _capture_wire_corpus(seed=42, target=2):
     corpus = set()
     for node in c.nodes.values():
         orig = node.transport.send
+        orig_many = node.transport.send_many
 
         def send(dest, payload, _orig=orig):
             corpus.add(payload)
             return _orig(dest, payload)
 
+        def send_many(items, _orig=orig_many):
+            corpus.update(p for _, p in items)
+            return _orig(items)
+
         node.transport.send = send
+        node.transport.send_many = send_many
     c.start()
     try:
         drive(c, [0, 1, 2, 3], target)
@@ -352,3 +358,206 @@ def test_wire_classify_non_engine_sqmessages_accepted():
     fake = serde.dumps(SqMessage.join_plan((1, b"plan")))
     assert serde.try_loads(fake, suite=suite) is None  # codec shape check
     assert int(lib.hbe_wire_classify(fake, len(fake))) == -1
+
+
+# ---------------------------------------------------------------------------
+# round 20: MSGB wire fast path — grammar parity + drain identity
+# ---------------------------------------------------------------------------
+
+
+def _msgb_engines_or_skip():
+    """A (producer, consumer) NativeNodeEngine pair in one 4-node net,
+    with producer egress already drained into per-payload frames.
+    Skips when the loaded engine predates the wire fast path (seed
+    snapshots via HBBFT_TPU_ENGINE_LIB)."""
+    _lib_or_skip()
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.native_engine import NativeNodeEngine
+    from hbbft_tpu.transport.cluster import build_netinfo
+
+    suite = ScalarSuite()
+    producer = NativeNodeEngine(
+        0, build_netinfo(4, 1, 0, suite, 0), seed=0, batch_size=3,
+        session_id=b"msgb-parity",
+    )
+    if not producer.supports_wire_batch:
+        pytest.skip("engine lib predates the MSGB wire fast path")
+    consumer = NativeNodeEngine(
+        1, build_netinfo(4, 1, 0, suite, 1), seed=0, batch_size=3,
+        session_id=b"msgb-parity",
+    )
+    producer.handle_input(Input.user("msgb-tx"))
+    producer.run()
+    payloads = []
+    producer.drain_egress(lambda d, p: payloads.append(p))
+    assert len(payloads) >= 3, "engine produced no broadcast egress"
+    return producer, consumer, payloads
+
+
+def test_msgb_engine_grammar_parity_with_python_validator():
+    """`hbe_node_ingest_wire`'s MSGB walk agrees with the Python
+    grammar authority (framing.validate_msgb) on every hostile body:
+    a Python-rejected body makes the engine count bad_payload (never
+    crash, never read OOB — the sanitizer tier covers memory safety);
+    a Python-accepted body of live traffic is fully consumed with
+    every message accounted exactly once."""
+    from hbbft_tpu.transport.framing import FrameError, msgb_body, validate_msgb
+
+    _, consumer, payloads = _msgb_engines_or_skip()
+    k = min(len(payloads), 5)
+    good = msgb_body(payloads[:k])
+
+    def py_count(body):
+        try:
+            return validate_msgb(body)
+        except FrameError:
+            return None
+
+    def engine_deltas(nm, body):
+        before = consumer.stats()
+        consumer.ingest_wire([0], [(nm, body)])
+        after = consumer.stats()
+        return (
+            after["handled"] - before["handled"],
+            after["bad_payload"] - before["bad_payload"],
+        )
+
+    # the clean body: grammar-accepted on both sides, all k consumable
+    assert py_count(good) == k
+    handled, bad = engine_deltas(k, good)
+    assert (handled, bad) == (k, 0)
+
+    def nm_claim(body):
+        # what a (hypothetically fooled) transport would claim: the
+        # declared count where parseable, else 1 — never 0, which
+        # would route down the plain-MSG path instead of the walk
+        if len(body) >= 4:
+            return max(1, int.from_bytes(body[:4], "big"))
+        return 1
+
+    hostile = [
+        (k + 1).to_bytes(4, "big") + good[4:],          # inflated count
+        good[: len(good) // 2],                          # truncated
+        good + b"\x00\x07",                              # trailing bytes
+        (0).to_bytes(4, "big"),                          # zero count
+        b"",                                             # no count field
+        good[:4] + (1 << 24).to_bytes(4, "big") + good[8:],  # overlong elem
+    ]
+    for body in hostile:
+        assert py_count(body) is None, body[:16]
+        handled, bad = engine_deltas(nm_claim(body), body)
+        assert bad >= 1, (body[:16], handled, bad)
+    # record-claim mismatch: the body is well-formed but the record
+    # header lies about the count — every claimed message is bad
+    handled, bad = engine_deltas(k + 1, good)
+    assert (handled, bad) == (0, k + 1)
+
+    # fuzz sweep: every truncation, plus bit flips through the count
+    # field and the first element header — full accept/reject parity
+    rng = random.Random(2020)
+    cases = [good[:cut] for cut in range(len(good))]
+    for _ in range(300):
+        i = rng.randrange(min(len(good), 8))
+        cases.append(
+            good[:i] + bytes([good[i] ^ (1 << rng.randrange(8))]) + good[i + 1:]
+        )
+    checked_rejects = 0
+    for body in cases:
+        want = py_count(body)
+        handled, bad = engine_deltas(
+            want if want is not None else nm_claim(body), body
+        )
+        if want is None:
+            checked_rejects += 1
+            assert bad >= 1, body[:16]
+        else:
+            # grammar-accepted mutant: every message accounted exactly
+            # once (handled if serde-consumable, bad_payload otherwise)
+            assert handled + bad == want, (body[:16], handled, bad, want)
+    assert checked_rejects > 100
+
+
+def test_msgb_drain_matches_per_frame_drain():
+    """`hbe_node_egress_drain_msgb` re-groups the SAME payload stream
+    the per-frame drain emits: per destination, concatenating the
+    decoded MSGB groups (in emission order) reproduces the per-frame
+    (dest, payload) sequence byte-for-byte — at a roomy max_body and
+    at a tiny one that forces every group down to a singleton."""
+    from hbbft_tpu.crypto.suite import ScalarSuite
+    from hbbft_tpu.native_engine import NativeNodeEngine
+    from hbbft_tpu.transport.cluster import build_netinfo
+    from hbbft_tpu.transport.framing import decode_msgb
+
+    _lib_or_skip()
+    suite = ScalarSuite()
+
+    def fresh():
+        eng = NativeNodeEngine(
+            0, build_netinfo(4, 1, 0, suite, 0), seed=0, batch_size=3,
+            session_id=b"msgb-drain",
+        )
+        if not eng.supports_wire_batch:
+            pytest.skip("engine lib predates the MSGB wire fast path")
+        eng.handle_input(Input.user("drain-tx"))
+        eng.run()
+        return eng
+
+    per_frame = {}
+    nframes = fresh().drain_egress(
+        lambda d, p: per_frame.setdefault(d, []).append(p)
+    )
+    assert nframes >= 3 and len(per_frame) >= 2  # a real broadcast
+
+    for max_body, expect_batched in ((1 << 20, True), (1, False)):
+        grouped = {}
+        singles_only = True
+
+        def emit(dest, nmsg, body):
+            nonlocal singles_only
+            if nmsg > 1:
+                singles_only = False
+            grouped.setdefault(dest, []).extend(decode_msgb(body))
+
+        fresh().drain_egress_msgb(emit, max_body)
+        assert grouped == per_frame, f"stream diverged at max_body={max_body}"
+        if expect_batched:
+            assert not singles_only, "roomy max_body never coalesced"
+        else:
+            assert singles_only, "max_body=1 (clamped 16) still batched"
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_native_churn_disconnect_reconnect_catches_up(coalesce):
+    """The round-8 disconnect-mid-epoch resume drill on NATIVE nodes,
+    on both coalescing arms: cutting a live node mid-MSGB-burst is
+    exactly as lossless as the per-frame arm (frame-unit ACK, batch-
+    atomic consumption — a partially-delivered batch retransmits
+    whole), and the native egress fast path replays through the same
+    resume layer."""
+    _lib_or_skip()
+    with LocalCluster(
+        4, seed=7, node_impl="native",
+        transport_kwargs={"coalesce": coalesce},
+    ) as c:
+        drive(c, [0, 1, 2, 3], 2)
+        c.disconnect(3)
+        base = len(c.batches(0))
+        drive(c, [0, 1, 2], base + 3, tag="out")
+        assert len(c.batches(3)) < len(c.batches(0))  # it really was cut off
+        c.reconnect(3)
+        target = len(c.batches(0))
+
+        def caught_up(cl):
+            return len(cl.batches(3)) >= target
+
+        assert c.wait(caught_up, EPOCH_TIMEOUT_S), (len(c.batches(3)), target)
+        b0, b3 = batch_keys(c, 0), batch_keys(c, 3)
+        kk = min(len(b0), len(b3))
+        assert b3[:kk] == b0[:kk]  # no lost outputs: identical prefix
+        keys = [(e, ep) for e, ep, _ in b3]
+        assert len(keys) == len(set(keys))  # no duplicate outputs
+        if coalesce:
+            st = c.nodes[0].transport.stats()
+            msgs = sum(s.get("msgs_out", 0) for s in st.values())
+            frames = sum(s.get("frames_out", 0) for s in st.values())
+            assert msgs > frames > 0  # the fast path actually coalesced

@@ -67,6 +67,18 @@ KNOBS: Dict[str, Knob] = {
             "change (docs/INVARIANTS.md \"RLC byte-identity\").",
         ),
         _k(
+            "HBBFT_TPU_COALESCE",
+            "1 (on)",
+            "transport (TcpTransport egress)",
+            "`0` restores one MSG frame per protocol message (round-20 "
+            "A/B arm).  On, each egress sweep packs a peer's pending "
+            "payloads into batched `KIND_MSGB` frames (bounded by "
+            "`max_frame_len`), acked per FRAME with batch-atomic "
+            "consumption — `batches_sha` is identical either way, and "
+            "mixed clusters interop because ingress always accepts both "
+            "kinds (docs/TRANSPORT.md \"Message coalescing\").",
+        ),
+        _k(
             "HBBFT_TPU_CRYPTO_RPC_TIMEOUT_S",
             "30.0",
             "cryptoplane/proc_service (RPC clients)",
